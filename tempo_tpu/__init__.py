@@ -1,0 +1,21 @@
+"""tempo_tpu — a TPU-native distributed tracing backend.
+
+Brand-new framework with the capabilities of Grafana Tempo (reference at
+/root/reference), rebuilt array-first on JAX/XLA/Pallas:
+
+- traces are columnar structure-of-arrays span batches end-to-end
+  (ingest buffers, WAL pages, blocks, query operands);
+- the block encoding's compaction (sort + dedupe + gather), bloom filter
+  construction/test/merge, HLL + count-min sketches, and column predicate
+  scans run as vmapped TPU kernels;
+- block ranges shard across a `jax.sharding.Mesh`, partial sketches and
+  blooms merge via psum/pmax over ICI;
+- the control plane (rings, queues, service lifecycle, object-store IO)
+  is host code, with native C++ codecs on the hot IO paths.
+
+Layer map mirrors the reference (SURVEY.md section 1): api -> modules ->
+db (tempodb) -> encoding -> backend, with ops/ (kernels) and parallel/
+(meshes + collectives) underneath the data plane.
+"""
+
+__version__ = "0.1.0"

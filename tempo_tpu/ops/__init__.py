@@ -1,0 +1,13 @@
+"""Device kernels: the array-first data plane.
+
+Everything here is pure-functional jax.numpy (jit/vmap/shard_map friendly,
+static shapes only) with numpy mirrors for host-side verification. These
+kernels replace the perf-critical pure-Go vendored components of the
+reference (SURVEY.md section 2.9): willf/bloom -> ops.bloom, hashing
+(pkg/util/hash.go) -> ops.hashing, the compactor's k-way object merge
+(tempodb/encoding/vparquet/compactor.go) -> ops.merge, column predicate
+scans (pkg/parquetquery) -> ops.scan, and adds HLL/count-min sketches for
+cardinality (north star in BASELINE.json).
+"""
+
+from tempo_tpu.ops import bloom, hashing, merge, scan, sketch  # noqa: F401

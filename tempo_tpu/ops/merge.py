@@ -1,0 +1,149 @@
+"""Sort/dedupe/segment kernels — the compaction core.
+
+The reference compactor is a comparison-based k-way streaming merge with
+data-dependent combine (tempodb/encoding/vparquet/compactor.go:31-215 and
+multiblock_iterator.go): bookmark per input block, pop lowest trace ID,
+dedupe equal rows or reconstruct+combine object trees.
+
+The TPU formulation is dataflow instead of control flow: concatenate the
+input blocks' span rows, lexsort by (traceID limbs, spanID limbs), mark
+first occurrences, and gather. Duplicate spans (replication factor > 1
+writes every span to multiple ingesters — SURVEY.md P1) collapse via the
+mask; spans of the same trace become adjacent, which is exactly the
+"combine" the reference does by rebuilding proto objects. One sort
+replaces the whole bookmark machinery, and it runs on device over an
+entire row-group batch.
+
+Keys are little arrays of uint32 limbs (big-endian limb order), so 128-bit
+trace IDs sort correctly without x64.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def lexsort_rows(keys: jnp.ndarray, valid: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Stable ascending sort of (N, L) uint32 rows -> permutation (N,) int32.
+
+    Invalid (padded) rows sort to the end regardless of key.
+    """
+    cols = [keys[:, i] for i in range(keys.shape[1])]
+    if valid is not None:
+        cols = [jnp.where(valid, jnp.uint32(0), jnp.uint32(1))] + cols
+    # jnp.lexsort: last key is primary -> reverse so column 0 is primary.
+    return jnp.lexsort(tuple(reversed(cols)))
+
+
+def first_occurrence_mask(sorted_keys: jnp.ndarray,
+                          valid_sorted: jnp.ndarray | None = None) -> jnp.ndarray:
+    """True where a sorted row differs from its predecessor (unique rows)."""
+    eq_prev = jnp.all(sorted_keys[1:] == sorted_keys[:-1], axis=1)
+    mask = jnp.concatenate([jnp.ones((1,), bool), ~eq_prev])
+    if valid_sorted is not None:
+        mask = mask & valid_sorted
+    return mask
+
+
+def segment_ids(change_mask: jnp.ndarray) -> jnp.ndarray:
+    """0-based contiguous segment index per row from a boundary mask."""
+    return jnp.cumsum(change_mask.astype(jnp.int32)) - 1
+
+
+@jax.jit
+def merge_spans(trace_limbs: jnp.ndarray, span_limbs: jnp.ndarray,
+                valid: jnp.ndarray | None = None):
+    """Plan a k-way merge+dedupe of span rows from several blocks.
+
+    Inputs are the concatenated rows of all input blocks:
+      trace_limbs (N,4) uint32, span_limbs (N,2) uint32, valid (N,) bool.
+
+    Returns dict with:
+      perm         (N,) int32  — gather order (sorted by trace, then span)
+      keep         (N,) bool   — in sorted order, first occurrence of
+                                 (trace, span); duplicates dropped
+      trace_seg    (N,) int32  — in sorted order, 0-based trace segment id
+      n_rows       ()   int32  — number of surviving span rows
+      n_traces     ()   int32  — number of distinct traces
+
+    Callers gather their payload columns with `perm`, then compact with
+    `keep` (host side, or via a second masked sort for fully on-device
+    compaction — see compact_by_mask).
+    """
+    keys = jnp.concatenate([trace_limbs, span_limbs], axis=1)
+    perm = lexsort_rows(keys, valid)
+    skeys = keys[perm]
+    svalid = valid[perm] if valid is not None else jnp.ones(keys.shape[0], bool)
+    keep = first_occurrence_mask(skeys, svalid)
+    trace_new = first_occurrence_mask(skeys[:, :4], svalid)
+    # only count a trace boundary on rows that survive dedupe
+    tseg = segment_ids(trace_new & keep)
+    return {
+        "perm": perm,
+        "keep": keep,
+        "trace_seg": tseg,
+        "n_rows": jnp.sum(keep.astype(jnp.int32)),
+        "n_traces": jnp.sum((trace_new & keep).astype(jnp.int32)),
+    }
+
+
+@jax.jit
+def compact_by_mask(values: jnp.ndarray, keep: jnp.ndarray) -> jnp.ndarray:
+    """Stable partition: rows with keep=True move to the front (static shape).
+
+    Tail rows are garbage and must be masked by the returned count from
+    merge_spans. Implemented as an argsort over (!keep, position).
+    """
+    n = keep.shape[0]
+    rank = jnp.where(keep, jnp.int32(0), jnp.int32(1))
+    order = jnp.lexsort((jnp.arange(n, dtype=jnp.int32), rank))
+    return values[order]
+
+
+@jax.jit
+def min_max_ids(trace_limbs: jnp.ndarray, valid: jnp.ndarray | None = None):
+    """Lexicographic min and max trace ID of a batch -> ((4,),(4,)) uint32.
+
+    Feeds BlockMeta.MinID/MaxID (reference: tempodb/backend/block_meta.go),
+    which trace-by-ID sharding prunes on (tempodb/tempodb.go:494-517).
+    """
+    perm = lexsort_rows(trace_limbs, valid)
+    lo = trace_limbs[perm[0]]
+    n_valid = (jnp.sum(valid.astype(jnp.int32)) if valid is not None
+               else jnp.int32(trace_limbs.shape[0]))
+    # all-invalid batches (fully padded tiles) yield undefined lo/hi; the
+    # caller must skip empty batches (an empty block is never written).
+    hi = trace_limbs[perm[jnp.maximum(n_valid, 1) - 1]]
+    return lo, hi
+
+
+# ---------------------------------------------------------------------------
+# numpy mirror
+# ---------------------------------------------------------------------------
+
+
+def np_merge_spans(trace_limbs: np.ndarray, span_limbs: np.ndarray,
+                   valid: np.ndarray | None = None):
+    keys = np.concatenate([trace_limbs, span_limbs], axis=1)
+    if valid is None:
+        valid = np.ones(keys.shape[0], bool)
+    cols = [np.where(valid, 0, 1).astype(np.uint32)] + [keys[:, i] for i in range(keys.shape[1])]
+    perm = np.lexsort(tuple(reversed(cols)))
+    skeys = keys[perm]
+    svalid = valid[perm]
+    eq_prev = np.all(skeys[1:] == skeys[:-1], axis=1)
+    keep = np.concatenate([[True], ~eq_prev]) & svalid
+    teq_prev = np.all(skeys[1:, :4] == skeys[:-1, :4], axis=1)
+    tnew = (np.concatenate([[True], ~teq_prev]) & svalid) & keep
+    return {
+        "perm": perm,
+        "keep": keep,
+        "trace_seg": np.cumsum(tnew.astype(np.int32)) - 1,
+        "n_rows": int(keep.sum()),
+        "n_traces": int(tnew.sum()),
+    }

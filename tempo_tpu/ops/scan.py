@@ -1,0 +1,117 @@
+"""Column predicate scans — the search/TraceQL fetch kernels.
+
+Role-equivalent to the reference's parquetquery predicate pushdown
+(pkg/parquetquery/predicates.go:13-446 and the iterator trees built in
+tempodb/encoding/vparquet/block_traceql.go): evaluate per-span predicates
+against columnar data, then roll span-level hits up to trace level.
+
+TPU-first shape: a row group is a set of fixed-length column arrays on
+device. String predicates are resolved host-side against the row group's
+dictionary (the reference's dictionary-pruning trick,
+pkg/parquetquery/predicates.go:446) into a small set of matching codes;
+the device kernel is then pure integer compares — eq / in-set / range —
+fused by the XLA elementwise fuser into a single pass over the columns.
+
+Trace-level rollup uses segment reductions over the span->trace segment
+index that block encoding stores per row group.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+NO_MATCH_CODE = np.uint32(0xFFFFFFFF)  # dictionary code guaranteed unused
+
+
+def eq(col: jnp.ndarray, value) -> jnp.ndarray:
+    return col == jnp.asarray(value, col.dtype)
+
+
+def in_set(col: jnp.ndarray, values: jnp.ndarray) -> jnp.ndarray:
+    """col (N,) in values (S,) -> (N,) bool. S is small and static.
+
+    An empty candidate set is encoded by passing [NO_MATCH_CODE].
+    """
+    if values.shape[0] == 0:
+        return jnp.zeros(col.shape, bool)
+    return jnp.any(col[:, None] == values[None, :].astype(col.dtype), axis=1)
+
+
+def between(col: jnp.ndarray, lo, hi) -> jnp.ndarray:
+    """lo <= col <= hi (inclusive both ends, matching parquetquery's
+    IntBetweenPredicate semantics)."""
+    c = col
+    return (c >= jnp.asarray(lo, c.dtype)) & (c <= jnp.asarray(hi, c.dtype))
+
+
+def time_overlap(start: jnp.ndarray, end: jnp.ndarray, req_start, req_end) -> jnp.ndarray:
+    """Span/trace [start,end] intersects request window [req_start,req_end]."""
+    return (end >= jnp.asarray(req_start, end.dtype)) & (start <= jnp.asarray(req_end, start.dtype))
+
+
+def spans_to_traces_any(span_mask: jnp.ndarray, trace_seg: jnp.ndarray,
+                        num_traces: int) -> jnp.ndarray:
+    """Trace matches if ANY of its spans matched (tag-search semantics,
+    reference: vparquet/block_search.go pipeline)."""
+    return jax.ops.segment_max(span_mask.astype(jnp.int32), trace_seg,
+                               num_segments=num_traces) > 0
+
+
+def spans_to_traces_count(span_mask: jnp.ndarray, trace_seg: jnp.ndarray,
+                          num_traces: int) -> jnp.ndarray:
+    """Matching-span count per trace (for TraceQL `| count() > n`)."""
+    return jax.ops.segment_sum(span_mask.astype(jnp.int32), trace_seg,
+                               num_segments=num_traces)
+
+
+def segment_reduce(values: jnp.ndarray, span_mask: jnp.ndarray,
+                   trace_seg: jnp.ndarray, num_traces: int, op: str):
+    """Per-trace reduction over matching spans' values.
+
+    op in {sum, min, max}: backs TraceQL spanset aggregates
+    (avg = sum/count at the call site).
+    Non-matching spans contribute the op identity.
+    """
+    v = values.astype(jnp.float32)
+    if op == "sum":
+        v = jnp.where(span_mask, v, 0.0)
+        return jax.ops.segment_sum(v, trace_seg, num_segments=num_traces)
+    if op == "min":
+        v = jnp.where(span_mask, v, jnp.inf)
+        return jax.ops.segment_min(v, trace_seg, num_segments=num_traces)
+    if op == "max":
+        v = jnp.where(span_mask, v, -jnp.inf)
+        return jax.ops.segment_max(v, trace_seg, num_segments=num_traces)
+    raise ValueError(f"unknown op {op!r}")
+
+
+def find_ids(trace_limbs: jnp.ndarray, target: jnp.ndarray) -> jnp.ndarray:
+    """Rows whose 128-bit trace ID equals target (4,) -> (N,) bool.
+
+    The trace-by-ID row-group scan after bloom says 'maybe'
+    (reference: vparquet/block_findtracebyid.go binary search; here a
+    vectorized compare is cheaper than branching on device).
+    """
+    return jnp.all(trace_limbs == target[None, :].astype(trace_limbs.dtype), axis=1)
+
+
+# ---------------------------------------------------------------------------
+# host helpers: dictionary-side string predicate resolution
+# ---------------------------------------------------------------------------
+
+
+def dict_codes_matching(entries: list, predicate) -> np.ndarray:
+    """Apply a python string predicate to dictionary entries -> uint32 codes.
+
+    Regex/substring/prefix never run on device — only over the (small)
+    dictionary, exactly like the reference prunes pages by dictionary
+    before scanning (pkg/parquetquery/predicates.go:446).
+    Returns [NO_MATCH_CODE] when nothing matches so in_set stays static.
+    """
+    codes = [i for i, e in enumerate(entries) if predicate(e)]
+    if not codes:
+        return np.array([NO_MATCH_CODE], dtype=np.uint32)
+    return np.asarray(codes, dtype=np.uint32)

@@ -1,0 +1,154 @@
+"""HyperLogLog and count-min sketches as device kernels.
+
+These back the metrics-generator's cardinality accounting (service-graph
+edge cardinality, active-series estimation — reference:
+modules/generator/registry active-series limiting) and the compactor's
+per-block statistics. They are designed around mesh merges:
+
+- HLL registers merge with elementwise max  -> `pmax` over ICI;
+- count-min counters merge with elementwise add -> `psum` over ICI.
+
+That makes a sharded compaction's global distinct-trace count and
+hot-key estimates one collective away from the per-shard partials
+(BASELINE.json north star: "psum over ICI to merge sketches across
+sharded block ranges").
+
+All state is uint32; HLL uses 32-bit hashing with p index bits from one
+hash stream and the rank (leading-zero count) from an independent stream.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tempo_tpu.ops import hashing
+
+
+# ---------------------------------------------------------------------------
+# HyperLogLog
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class HLLPlan:
+    precision: int = 12  # m = 2**precision registers
+
+    def __post_init__(self):
+        if not (4 <= self.precision <= 18):
+            raise ValueError(f"HLL precision must be in [4,18], got {self.precision}")
+
+    @property
+    def m(self) -> int:
+        return 1 << self.precision
+
+
+def hll_init(p: HLLPlan) -> jnp.ndarray:
+    return jnp.zeros((p.m,), dtype=jnp.uint32)
+
+
+@partial(jax.jit, static_argnames=("p",))
+def hll_update(regs: jnp.ndarray, limbs: jnp.ndarray, p: HLLPlan,
+               valid: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Fold a batch of keys into the register array (scatter-max)."""
+    base = hashing.fnv1a_32(limbs)
+    h_idx = hashing.fmix32(base, seed=0x2545F491)
+    h_rho = hashing.fmix32(base, seed=0x27220A95)
+    idx = h_idx & jnp.uint32(p.m - 1)
+    # rank = position of first set bit in an independent 32-bit stream, 1-based
+    rho = jax.lax.clz(h_rho).astype(jnp.uint32) + jnp.uint32(1)
+    if valid is not None:
+        idx = jnp.where(valid, idx, jnp.uint32(p.m))  # trash slot
+        regs = jnp.concatenate([regs, jnp.zeros((1,), jnp.uint32)])
+        regs = regs.at[idx].max(rho)
+        return regs[: p.m]
+    return regs.at[idx].max(rho)
+
+
+@jax.jit
+def hll_merge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.maximum(a, b)
+
+
+@partial(jax.jit, static_argnames=("p",))
+def hll_estimate(regs: jnp.ndarray, p: HLLPlan) -> jnp.ndarray:
+    """Cardinality estimate (float32), with linear-counting small-range fix."""
+    m = p.m
+    alpha = {16: 0.673, 32: 0.697, 64: 0.709}.get(m, 0.7213 / (1 + 1.079 / m))
+    inv = jnp.sum(jnp.exp2(-regs.astype(jnp.float32)))
+    raw = alpha * m * m / inv
+    zeros = jnp.sum((regs == 0).astype(jnp.float32))
+    linear = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+    small = raw <= 2.5 * m
+    return jnp.where(small & (zeros > 0), linear, raw)
+
+
+# ---------------------------------------------------------------------------
+# count-min
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class CMPlan:
+    depth: int = 4
+    width: int = 1 << 12  # must be a power of two (indices are masked, not mod'd)
+
+    def __post_init__(self):
+        if self.width <= 0 or self.width & (self.width - 1):
+            raise ValueError(f"CM width must be a power of two, got {self.width}")
+        if self.depth < 1:
+            raise ValueError(f"CM depth must be >= 1, got {self.depth}")
+
+
+def cm_init(p: CMPlan) -> jnp.ndarray:
+    return jnp.zeros((p.depth, p.width), dtype=jnp.uint32)
+
+
+def _cm_indices(limbs: jnp.ndarray, p: CMPlan) -> jnp.ndarray:
+    hs = hashing.hash_streams(limbs, p.depth, seed=0x5BD1E995)
+    return hs & jnp.uint32(p.width - 1)  # (depth, N)
+
+
+@partial(jax.jit, static_argnames=("p",))
+def cm_update(counts: jnp.ndarray, limbs: jnp.ndarray, p: CMPlan,
+              weights: jnp.ndarray | None = None,
+              valid: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Scatter-add a batch of keys (optionally weighted) into the sketch."""
+    idx = _cm_indices(limbs, p)  # (depth, N)
+    n = limbs.shape[0]
+    w = jnp.ones((n,), jnp.uint32) if weights is None else weights.astype(jnp.uint32)
+    if valid is not None:
+        w = jnp.where(valid, w, jnp.uint32(0))
+    rows = jnp.broadcast_to(jnp.arange(p.depth, dtype=jnp.uint32)[:, None], idx.shape)
+    flat = rows.ravel() * jnp.uint32(p.width) + idx.ravel()
+    out = counts.ravel().at[flat].add(jnp.broadcast_to(w[None, :], idx.shape).ravel())
+    return out.reshape(p.depth, p.width)
+
+
+@jax.jit
+def cm_merge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return a + b
+
+
+@partial(jax.jit, static_argnames=("p",))
+def cm_query(counts: jnp.ndarray, limbs: jnp.ndarray, p: CMPlan) -> jnp.ndarray:
+    """Point estimate per key: min over rows (classic CM upper bound)."""
+    idx = _cm_indices(limbs, p)  # (depth, N)
+    gathered = jnp.take_along_axis(counts, idx, axis=1)  # (depth, N)
+    return jnp.min(gathered, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# numpy mirrors for verification
+# ---------------------------------------------------------------------------
+
+
+def np_hll_estimate_exact(keys: np.ndarray) -> int:
+    """Host ground truth: exact distinct count of (N, L) uint32 keys."""
+    return np.unique(keys, axis=0).shape[0]

@@ -1,0 +1,21 @@
+"""Test harness configuration.
+
+Tests always run on a virtual 8-device CPU mesh so multi-chip sharding
+(`shard_map` + psum/pmax sketch merges) is exercised without TPU hardware,
+mirroring how the reference tests its distributed paths with in-process
+rings and local backends (SURVEY.md section 4).
+
+Must run before the first `import jax` anywhere in the test process.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,262 @@
+"""Kernel-layer unit tests (CPU jax, mirrors SURVEY.md section 4 strategy:
+deterministic synthetic inputs, device kernels checked against numpy
+ground truth)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tempo_tpu.ops import bloom, hashing, merge, scan, sketch
+
+
+def rand_ids(n, seed=0, dupes=0.0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, 2**32, size=(n, 4), dtype=np.uint32)
+    if dupes > 0:
+        k = int(n * dupes)
+        idx = rng.integers(0, n, size=k)
+        src = rng.integers(0, n, size=k)
+        ids[idx] = ids[src]
+    return ids
+
+
+class TestHashing:
+    def test_fnv1a_matches_byte_serial(self):
+        ids = rand_ids(64, seed=1)
+        dev = np.asarray(hashing.fnv1a_32(jnp.asarray(ids)))
+        for row, got in zip(ids, dev):
+            tid = hashing.limbs_to_trace_id(row)
+            h = 0x811C9DC5
+            for b in tid:
+                h = ((h ^ b) * 0x01000193) & 0xFFFFFFFF
+            assert got == h
+
+    def test_np_mirror_agrees(self):
+        ids = rand_ids(128, seed=2)
+        assert np.array_equal(
+            np.asarray(hashing.fnv1a_32(jnp.asarray(ids))), hashing.np_fnv1a_32(ids)
+        )
+        h = hashing.np_fnv1a_32(ids)
+        assert np.array_equal(
+            np.asarray(hashing.fmix32(jnp.asarray(h), seed=7)), hashing.np_fmix32(h, seed=7)
+        )
+
+    def test_limbs_roundtrip(self):
+        tid = bytes(range(16))
+        limbs = hashing.trace_id_to_limbs(tid)
+        assert hashing.limbs_to_trace_id(limbs) == tid
+
+    def test_token_for_distributes(self):
+        toks = {hashing.token_for("tenant", bytes([i]) * 16) % 4 for i in range(64)}
+        assert len(toks) == 4  # all 4 buckets hit
+
+
+class TestBloom:
+    def test_no_false_negatives(self):
+        ids = rand_ids(2000, seed=3)
+        p = bloom.plan(2000, 0.01)
+        words = bloom.build(jnp.asarray(ids), p)
+        assert bool(np.asarray(bloom.test(words, jnp.asarray(ids), p)).all())
+
+    def test_fp_rate_reasonable(self):
+        ids = rand_ids(5000, seed=4)
+        others = rand_ids(5000, seed=5)
+        p = bloom.plan(5000, 0.01)
+        words = bloom.build(jnp.asarray(ids), p)
+        hits = np.asarray(bloom.test(words, jnp.asarray(others), p))
+        assert hits.mean() < 0.05  # ~1% target, generous bound
+
+    def test_merge_is_union(self):
+        a, b = rand_ids(500, seed=6), rand_ids(500, seed=7)
+        p = bloom.plan(1000, 0.01)
+        wa = bloom.build(jnp.asarray(a), p)
+        wb = bloom.build(jnp.asarray(b), p)
+        m = bloom.merge(wa, wb)
+        both = jnp.asarray(np.concatenate([a, b]))
+        assert bool(np.asarray(bloom.test(m, both, p)).all())
+
+    def test_psum_clamp_equals_or(self):
+        # bits summed then clamped == OR: the ICI merge trick
+        a, b = rand_ids(300, seed=8), rand_ids(300, seed=9)
+        p = bloom.plan(600, 0.01)
+        wa, wb = bloom.build(jnp.asarray(a), p), bloom.build(jnp.asarray(b), p)
+        bits = lambda w: (w[..., None] >> jnp.arange(32, dtype=jnp.uint32)) & 1
+        summed = bits(wa) + bits(wb)
+        packed = jnp.sum(
+            (summed > 0).astype(jnp.uint32) << jnp.arange(32, dtype=jnp.uint32), axis=-1
+        )
+        assert np.array_equal(np.asarray(packed), np.asarray(bloom.merge(wa, wb)))
+
+    def test_valid_mask(self):
+        ids = rand_ids(100, seed=10)
+        p = bloom.plan(100, 0.01)
+        valid = np.zeros(100, bool)
+        valid[:50] = True
+        words = bloom.build(jnp.asarray(ids), p, valid=jnp.asarray(valid))
+        full = bloom.build(jnp.asarray(ids[:50]), p)
+        assert np.array_equal(np.asarray(words), np.asarray(full))
+
+    def test_single_shard_path_and_serialization(self):
+        ids = rand_ids(400, seed=11)
+        p = bloom.plan(400, 0.01, shard_size_bytes=128)  # force multiple shards
+        assert p.n_shards > 1
+        words = np.asarray(bloom.build(jnp.asarray(ids), p))
+        shards = bloom.shard_for_ids(ids, p)
+        for s in range(p.n_shards):
+            mine = ids[shards == s]
+            if len(mine) == 0:
+                continue
+            raw = bloom.shard_to_bytes(words[s])
+            back = bloom.shard_from_bytes(raw)
+            assert bool(
+                np.asarray(bloom.test_one_shard(jnp.asarray(back), jnp.asarray(mine), p)).all()
+            )
+            assert bloom.np_test_one_shard(back, mine, p).all()
+
+
+class TestSketch:
+    def test_hll_accuracy(self):
+        p = sketch.HLLPlan(precision=12)
+        for n, seed in [(100, 1), (5000, 2), (50000, 3)]:
+            ids = rand_ids(n, seed=seed)
+            regs = sketch.hll_update(sketch.hll_init(p), jnp.asarray(ids), p)
+            est = float(sketch.hll_estimate(regs, p))
+            exact = sketch.np_hll_estimate_exact(ids)
+            assert abs(est - exact) / exact < 0.1, (n, est, exact)
+
+    def test_hll_merge_max(self):
+        p = sketch.HLLPlan(precision=10)
+        a, b = rand_ids(1000, seed=4), rand_ids(1000, seed=5)
+        ra = sketch.hll_update(sketch.hll_init(p), jnp.asarray(a), p)
+        rb = sketch.hll_update(sketch.hll_init(p), jnp.asarray(b), p)
+        merged = sketch.hll_merge(ra, rb)
+        combined = sketch.hll_update(ra, jnp.asarray(b), p)
+        assert np.array_equal(np.asarray(merged), np.asarray(combined))
+
+    def test_hll_valid_mask(self):
+        p = sketch.HLLPlan(precision=10)
+        ids = rand_ids(200, seed=6)
+        valid = np.arange(200) < 100
+        r1 = sketch.hll_update(sketch.hll_init(p), jnp.asarray(ids), p, valid=jnp.asarray(valid))
+        r2 = sketch.hll_update(sketch.hll_init(p), jnp.asarray(ids[:100]), p)
+        assert np.array_equal(np.asarray(r1), np.asarray(r2))
+
+    def test_cm_upper_bound_and_exactish(self):
+        p = sketch.CMPlan(depth=4, width=1 << 12)
+        rng = np.random.default_rng(7)
+        keys = rand_ids(50, seed=8)
+        freq = rng.integers(1, 100, size=50)
+        rows = np.repeat(keys, freq, axis=0)
+        counts = sketch.cm_update(sketch.cm_init(p), jnp.asarray(rows), p)
+        est = np.asarray(sketch.cm_query(counts, jnp.asarray(keys), p))
+        assert (est >= freq).all()  # never underestimates
+        assert (est <= freq + rows.shape[0] * 4 / p.width + 1).all()
+
+    def test_cm_merge_add(self):
+        p = sketch.CMPlan()
+        a, b = rand_ids(500, seed=9), rand_ids(500, seed=10)
+        ca = sketch.cm_update(sketch.cm_init(p), jnp.asarray(a), p)
+        cb = sketch.cm_update(sketch.cm_init(p), jnp.asarray(b), p)
+        merged = sketch.cm_merge(ca, cb)
+        seq = sketch.cm_update(ca, jnp.asarray(b), p)
+        assert np.array_equal(np.asarray(merged), np.asarray(seq))
+
+    def test_cm_weights(self):
+        p = sketch.CMPlan()
+        keys = rand_ids(10, seed=11)
+        w = np.arange(1, 11, dtype=np.uint32)
+        counts = sketch.cm_update(sketch.cm_init(p), jnp.asarray(keys), p, weights=jnp.asarray(w))
+        est = np.asarray(sketch.cm_query(counts, jnp.asarray(keys), p))
+        assert (est >= w).all()
+
+
+class TestMerge:
+    def test_matches_numpy_mirror(self):
+        tids = rand_ids(1000, seed=12, dupes=0.3)
+        sids = rand_ids(1000, seed=13, dupes=0.3)[:, :2]
+        got = merge.merge_spans(jnp.asarray(tids), jnp.asarray(sids))
+        want = merge.np_merge_spans(tids, sids)
+        assert int(got["n_rows"]) == want["n_rows"]
+        assert int(got["n_traces"]) == want["n_traces"]
+        skeys = np.concatenate([tids, sids], 1)[np.asarray(got["perm"])]
+        assert (np.diff([tuple(r) for r in skeys.tolist()], axis=0) != 0).any(axis=1).sum() >= 0
+        # sortedness: rows nondecreasing lexicographically
+        as_tuples = [tuple(r) for r in skeys.tolist()]
+        assert as_tuples == sorted(as_tuples)
+
+    def test_dedupe_counts(self):
+        # 3 copies of 10 spans + 5 unique -> 15 unique rows
+        base_t = rand_ids(10, seed=14)
+        base_s = rand_ids(10, seed=15)[:, :2]
+        extra_t = rand_ids(5, seed=16)
+        extra_s = rand_ids(5, seed=17)[:, :2]
+        tids = np.concatenate([base_t, base_t, base_t, extra_t])
+        sids = np.concatenate([base_s, base_s, base_s, extra_s])
+        got = merge.merge_spans(jnp.asarray(tids), jnp.asarray(sids))
+        assert int(got["n_rows"]) == 15
+        assert int(got["n_traces"]) == 15  # all trace ids distinct here
+
+    def test_valid_padding(self):
+        tids = rand_ids(64, seed=18)
+        sids = rand_ids(64, seed=19)[:, :2]
+        valid = np.arange(64) < 40
+        got = merge.merge_spans(jnp.asarray(tids), jnp.asarray(sids), valid=jnp.asarray(valid))
+        want = merge.np_merge_spans(tids[:40], sids[:40])
+        assert int(got["n_rows"]) == want["n_rows"]
+        assert int(got["n_traces"]) == want["n_traces"]
+
+    def test_compact_by_mask(self):
+        vals = jnp.arange(10, dtype=jnp.int32)
+        keep = jnp.asarray([True, False, True, True, False, False, True, False, False, True])
+        out = np.asarray(merge.compact_by_mask(vals, keep))
+        assert list(out[:5]) == [0, 2, 3, 6, 9]
+
+    def test_min_max_ids(self):
+        tids = rand_ids(100, seed=20)
+        valid = np.arange(100) < 77
+        lo, hi = merge.min_max_ids(jnp.asarray(tids), jnp.asarray(valid))
+        as_tuples = sorted(tuple(r) for r in tids[:77].tolist())
+        assert tuple(np.asarray(lo).tolist()) == as_tuples[0]
+        assert tuple(np.asarray(hi).tolist()) == as_tuples[-1]
+
+
+class TestScan:
+    def test_predicates(self):
+        col = jnp.asarray(np.array([1, 2, 3, 4, 5, 2], dtype=np.uint32))
+        assert np.asarray(scan.eq(col, 2)).tolist() == [False, True, False, False, False, True]
+        s = jnp.asarray(np.array([2, 5], dtype=np.uint32))
+        assert np.asarray(scan.in_set(col, s)).tolist() == [False, True, False, False, True, True]
+        assert np.asarray(scan.between(col, 2, 4)).tolist() == [False, True, True, True, False, True]
+
+    def test_empty_set_matches_nothing(self):
+        col = jnp.asarray(np.arange(8, dtype=np.uint32))
+        codes = scan.dict_codes_matching(["a", "b"], lambda e: False)
+        assert not np.asarray(scan.in_set(col, jnp.asarray(codes))).any()
+
+    def test_trace_rollup(self):
+        span_mask = jnp.asarray([True, False, False, True, False])
+        seg = jnp.asarray([0, 0, 1, 2, 2])
+        hit = np.asarray(scan.spans_to_traces_any(span_mask, seg, 3))
+        assert hit.tolist() == [True, False, True]
+        cnt = np.asarray(scan.spans_to_traces_count(span_mask, seg, 3))
+        assert cnt.tolist() == [1, 0, 1]
+
+    def test_segment_reduce(self):
+        vals = jnp.asarray([10.0, 20.0, 5.0, 7.0])
+        mask = jnp.asarray([True, True, True, False])
+        seg = jnp.asarray([0, 0, 1, 1])
+        assert np.asarray(scan.segment_reduce(vals, mask, seg, 2, "sum")).tolist() == [30.0, 5.0]
+        assert np.asarray(scan.segment_reduce(vals, mask, seg, 2, "max")).tolist()[0] == 20.0
+        assert np.asarray(scan.segment_reduce(vals, mask, seg, 2, "min")).tolist()[1] == 5.0
+
+    def test_find_ids(self):
+        ids = rand_ids(32, seed=21)
+        target = jnp.asarray(ids[7])
+        hits = np.asarray(scan.find_ids(jnp.asarray(ids), target))
+        assert hits[7] and hits.sum() == (ids == ids[7]).all(axis=1).sum()
+
+    def test_dict_codes(self):
+        entries = ["GET /api", "POST /api", "GET /health"]
+        codes = scan.dict_codes_matching(entries, lambda e: e.startswith("GET"))
+        assert codes.tolist() == [0, 2]
