@@ -1,0 +1,19 @@
+"""Object-store backend abstraction.
+
+Mirrors the reference's tempodb/backend split (backend.go:22-69,
+raw.go:24-48): a raw byte-object layer (RawReader/RawWriter) under a
+typed layer that knows about block metas, blooms, and the per-tenant
+layout. Implementations: local filesystem (tempodb/backend/local),
+in-memory mock (tempodb/backend/mocks.go) for tests; cloud backends
+(GCS/S3/Azure) plug in behind the same Raw interface.
+"""
+
+from tempo_tpu.backend.base import (  # noqa: F401
+    BlockMeta,
+    CompactedBlockMeta,
+    NotFound,
+    RawBackend,
+    TypedBackend,
+)
+from tempo_tpu.backend.local import LocalBackend  # noqa: F401
+from tempo_tpu.backend.mock import MockBackend  # noqa: F401

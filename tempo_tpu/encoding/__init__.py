@@ -1,0 +1,37 @@
+"""Block encoding registry.
+
+Reference: tempodb/encoding/versioned.go:18-68 — a VersionedEncoding
+interface (OpenBlock / CreateBlock / NewCompactor / WAL block ops) keyed
+by version string, selected via the block-version config knob so the
+data plane swaps without touching the control plane. Here the flagship
+encoding is `vtpu1` (columnar, device-kernel scans); the registry keeps
+the same swap-ability so future encodings (e.g. a parquet-compatible
+interchange encoding) can plug in beside it.
+"""
+
+from __future__ import annotations
+
+from tempo_tpu.encoding import vtpu
+from tempo_tpu.encoding.common import BlockConfig, SearchRequest  # noqa: F401
+
+DEFAULT_ENCODING = "vtpu1"
+
+_REGISTRY = {
+    vtpu.VERSION: vtpu.Encoding(),
+}
+
+
+def from_version(version: str):
+    """version string -> encoding impl (reference: versioned.go:54-62)."""
+    enc = _REGISTRY.get(version)
+    if enc is None:
+        raise ValueError(f"unknown block encoding {version!r} (have {sorted(_REGISTRY)})")
+    return enc
+
+
+def default_encoding():
+    return from_version(DEFAULT_ENCODING)
+
+
+def all_encodings():
+    return list(_REGISTRY.values())

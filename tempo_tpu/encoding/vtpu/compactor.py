@@ -1,0 +1,86 @@
+"""Block compactor: k blocks -> 1 block via device sort/dedupe/gather.
+
+Reference analog: tempodb/encoding/vparquet/compactor.go:31-215 — k-way
+bookmark merge of parquet rows, object reconstruct+combine on ID
+collision, row pooling, GC calls. Here the whole merge is three device
+steps (ops.merge.merge_spans): lexsort all span rows by (traceID,
+spanID), mask duplicate rows, gather survivors — then stream the merged
+batch back out through the block writer.
+
+Memory note: inputs are materialized per *row group* then concatenated;
+for very large jobs the driver bounds input size via
+CompactionOptions/max block sizes picked by the block selector
+(tempodb/compaction_block_selector.go caps). A fully streamed variant
+(window the sorted stream through fixed-size device tiles) slots in
+behind the same interface; parallel/compaction.py shards block ranges
+across devices first, which divides per-shard working sets.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from tempo_tpu.backend.base import BlockMeta, TypedBackend
+from tempo_tpu.encoding.common import BlockConfig, CompactionOptions
+from tempo_tpu.encoding.vtpu import format as fmt
+from tempo_tpu.encoding.vtpu.block import VtpuBackendBlock
+from tempo_tpu.encoding.vtpu.create import write_block
+from tempo_tpu.model.columnar import ATTR_COLUMNS, SPAN_COLUMNS, SpanBatch
+from tempo_tpu.ops import merge
+
+
+class VtpuCompactor:
+    def __init__(self, opts: CompactionOptions | None = None):
+        self.opts = opts or CompactionOptions()
+        self.spans_dropped = 0
+
+    def compact(self, metas: list[BlockMeta], tenant: str, backend: TypedBackend) -> list[BlockMeta]:
+        """Merge input blocks; returns metas of output blocks (1 today)."""
+        cfg = self.opts.block_config
+        parts = []
+        for m in metas:
+            blk = VtpuBackendBlock(m, backend, cfg)
+            for rg in blk.index().row_groups:
+                cols = blk.read_columns(rg, list(SPAN_COLUMNS))
+                attrs = blk.read_columns(rg, list(ATTR_COLUMNS))
+                parts.append(SpanBatch(cols=cols, attrs=attrs, dictionary=blk.dictionary()))
+        if not parts:
+            return []
+        big = SpanBatch.concat(parts)
+
+        plan = merge.merge_spans(
+            jnp.asarray(big.cols["trace_id"]), jnp.asarray(big.cols["span_id"])
+        )
+        perm = np.asarray(plan["perm"])
+        keep = np.asarray(plan["keep"])
+        order = perm[keep]  # surviving rows in sorted order
+        merged = big.select(order)
+
+        if self.opts.max_spans_per_trace:
+            merged, dropped = _cap_spans_per_trace(merged, self.opts.max_spans_per_trace)
+            self.spans_dropped += dropped
+            if dropped and self.opts.on_spans_dropped:
+                self.opts.on_spans_dropped(dropped)
+
+        level = max(m.compaction_level for m in metas) + 1
+        out = write_block([merged], tenant, backend, cfg, compaction_level=level)
+        return [out] if out else []
+
+
+def _cap_spans_per_trace(batch: SpanBatch, cap: int) -> tuple[SpanBatch, int]:
+    """Drop spans beyond `cap` per trace (reference: oversize traces are
+    truncated + counted during compaction, vparquet/compactor.go:96-111)."""
+    _, seg = batch.trace_boundaries()
+    # rank of each span within its trace
+    idx = np.arange(batch.num_spans)
+    n_seg = int(seg.max()) + 1 if len(seg) else 0
+    first_of_seg = np.full(n_seg, batch.num_spans, dtype=np.int64)
+    np.minimum.at(first_of_seg, seg, idx)
+    rank = idx - first_of_seg[seg]
+    keep = rank < cap
+    dropped = int((~keep).sum())
+    if dropped == 0:
+        return batch, 0
+    return batch.select(np.flatnonzero(keep)), dropped

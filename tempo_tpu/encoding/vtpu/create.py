@@ -1,0 +1,107 @@
+"""Block writer: trace-sorted span batches -> a complete vtpu1 block.
+
+Reference analog: tempodb/encoding/vparquet/create.go (streamingBlock:
+append rows, flush row groups by size, bloom from IDs, meta last).
+Device kernels do the data-plane math: bloom build (ops.bloom), HLL
+distinct estimate (ops.sketch), min/max ID (ops.merge).
+
+Write order matters for crash safety: data pages are appended first,
+then bloom/index/dict, then meta.json LAST — a block without meta is
+invisible and gets garbage-collected, like the reference's write path
+(tempodb/tempodb.go WriteBlock).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from tempo_tpu.backend.base import (
+    BlockMeta,
+    ColumnIndexName,
+    DataName,
+    DictionaryName,
+    TypedBackend,
+    bloom_name,
+)
+from tempo_tpu.encoding.common import BlockConfig
+from tempo_tpu.encoding.vtpu import format as fmt
+from tempo_tpu.model.columnar import SpanBatch
+from tempo_tpu.ops import bloom, sketch
+
+
+def write_block(
+    batches,
+    tenant: str,
+    backend: TypedBackend,
+    cfg: BlockConfig,
+    block_id: str | None = None,
+    compaction_level: int = 0,
+) -> BlockMeta | None:
+    """Write one block from an iterable of trace-sorted SpanBatches in
+    nondecreasing trace order (a single batch is the common case; the
+    compactor streams several). Returns None for empty input."""
+    meta = BlockMeta(tenant_id=tenant, version=cfg.version, compaction_level=compaction_level)
+    if block_id:
+        meta.block_id = block_id
+
+    index = fmt.BlockIndex()
+    offset = 0
+    unique_ids: list[np.ndarray] = []
+    n_spans = 0
+    start_s, end_s = None, 0
+    min_id, max_id = None, None
+    dictionary = None
+
+    for batch in batches:
+        if batch.num_spans == 0:
+            continue
+        if dictionary is None:
+            dictionary = batch.dictionary
+        elif batch.dictionary is not dictionary:
+            raise ValueError("all batches of one block must share a dictionary")
+        firsts, _ = batch.trace_boundaries()
+        unique_ids.append(batch.cols["trace_id"][firsts])
+        for lo, hi in fmt.row_group_slices(batch, cfg.row_group_spans):
+            payload, rg = fmt.serialize_row_group(batch, lo, hi, offset, cfg.codec)
+            backend.append_named(meta, DataName, payload)
+            offset += len(payload)
+            index.row_groups.append(rg)
+            n_spans += rg.n_spans
+            start_s = rg.start_s if start_s is None else min(start_s, rg.start_s)
+            end_s = max(end_s, rg.end_s)
+            min_id = rg.min_id if min_id is None else min(min_id, rg.min_id)
+            max_id = rg.max_id if max_id is None else max(max_id, rg.max_id)
+
+    if not unique_ids:
+        return None
+
+    ids = np.concatenate(unique_ids)
+    plan = bloom.plan(len(ids), cfg.bloom_fp, cfg.bloom_shard_size_bytes)
+    words = np.asarray(bloom.build(jnp.asarray(ids), plan))
+    for s in range(plan.n_shards):
+        backend.write_named(meta, bloom_name(s), bloom.shard_to_bytes(words[s]))
+
+    hp = sketch.HLLPlan(cfg.hll_precision)
+    regs = sketch.hll_update(sketch.hll_init(hp), jnp.asarray(ids), hp)
+    est = int(float(sketch.hll_estimate(regs, hp)))
+
+    backend.write_named(meta, ColumnIndexName, index.to_bytes())
+    backend.write_named(meta, DictionaryName, fmt.serialize_dictionary(dictionary))
+
+    meta.start_time = int(start_s or 0)
+    meta.end_time = int(end_s)
+    meta.total_objects = int(len(ids))
+    meta.total_spans = int(n_spans)
+    meta.size_bytes = offset
+    meta.min_id = min_id
+    meta.max_id = max_id
+    meta.total_records = len(index.row_groups)
+    meta.bloom_shards = plan.n_shards
+    meta.bloom_bits_per_shard = plan.bits_per_shard
+    meta.bloom_k = plan.k
+    meta.hll_precision = cfg.hll_precision
+    meta.est_distinct_traces = est
+    backend.write_block_meta(meta)  # last: makes the block visible
+    return meta

@@ -1,0 +1,255 @@
+"""On-disk format: row groups, block index, dictionary, batch segments.
+
+Layout of data.bin: concatenation of row groups; each row group is a
+concatenation of column pages (one per span column, then one per attr
+column). index.json (gzip) records absolute (offset, length, crc) per
+page, so readers issue ranged GETs for exactly the columns a query
+touches (reference analog: parquet column chunk offsets +
+tempodb/backend ContextReader ranged reads).
+
+Row groups always end at trace boundaries (a trace never spans row
+groups), mirroring vParquet's trace-per-row invariant so per-row-group
+min/max trace ID pruning is exact.
+
+`serialize_batch`/`deserialize_batch` is the standalone segment form
+(WAL segments, distributor->ingester pushes): a self-contained header +
+pages + its own dictionary.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import struct
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from tempo_tpu.encoding.vtpu import codec as codec_mod
+from tempo_tpu.model.columnar import ATTR_COLUMNS, SPAN_COLUMNS, Dictionary, SpanBatch
+
+MAGIC = b"VTPU1\x00"
+
+
+def id_to_hex(limbs: np.ndarray) -> str:
+    return np.asarray(limbs, dtype=np.uint32).astype(">u4").tobytes().hex()
+
+
+def hex_to_limbs(h: str) -> np.ndarray:
+    return np.frombuffer(bytes.fromhex(h.rjust(32, "0")), dtype=">u4").astype(np.uint32)
+
+
+@dataclass
+class PageMeta:
+    offset: int  # absolute into data.bin
+    length: int
+    dtype: str
+    shape: tuple
+    codec: str
+    crc: int
+
+    def to_json(self):
+        return [self.offset, self.length, self.dtype, list(self.shape), self.codec, self.crc]
+
+    @staticmethod
+    def from_json(v):
+        return PageMeta(v[0], v[1], v[2], tuple(v[3]), v[4], v[5])
+
+
+@dataclass
+class RowGroupMeta:
+    n_spans: int
+    n_attrs: int
+    min_id: str  # hex, inclusive
+    max_id: str
+    start_s: int
+    end_s: int
+    n_traces: int = 0
+    pages: dict = field(default_factory=dict)  # column name -> PageMeta
+
+    def to_json(self):
+        return {
+            "n_spans": self.n_spans,
+            "n_attrs": self.n_attrs,
+            "min_id": self.min_id,
+            "max_id": self.max_id,
+            "start_s": self.start_s,
+            "end_s": self.end_s,
+            "n_traces": self.n_traces,
+            "pages": {k: v.to_json() for k, v in self.pages.items()},
+        }
+
+    @staticmethod
+    def from_json(d):
+        return RowGroupMeta(
+            n_spans=d["n_spans"],
+            n_attrs=d["n_attrs"],
+            min_id=d["min_id"],
+            max_id=d["max_id"],
+            start_s=d["start_s"],
+            end_s=d["end_s"],
+            n_traces=d.get("n_traces", 0),
+            pages={k: PageMeta.from_json(v) for k, v in d["pages"].items()},
+        )
+
+
+@dataclass
+class BlockIndex:
+    row_groups: list = field(default_factory=list)  # list[RowGroupMeta]
+
+    def to_bytes(self) -> bytes:
+        return gzip.compress(json.dumps({"row_groups": [r.to_json() for r in self.row_groups]}).encode())
+
+    @staticmethod
+    def from_bytes(raw: bytes) -> "BlockIndex":
+        d = json.loads(gzip.decompress(raw))
+        return BlockIndex(row_groups=[RowGroupMeta.from_json(r) for r in d["row_groups"]])
+
+
+def serialize_dictionary(d: Dictionary) -> bytes:
+    return gzip.compress(json.dumps(d.entries).encode())
+
+
+def deserialize_dictionary(raw: bytes) -> Dictionary:
+    return Dictionary(json.loads(gzip.decompress(raw)))
+
+
+def serialize_row_group(batch: SpanBatch, lo: int, hi: int, base_offset: int,
+                        codec: str) -> tuple[bytes, RowGroupMeta]:
+    """Serialize span rows [lo:hi) (and their attrs) as one row group.
+
+    Row indices in the attr pages are rebased to the row group start so
+    each row group decodes standalone.
+    """
+    n = hi - lo
+    owner = batch.attrs["attr_span"]
+    amask = (owner >= lo) & (owner < hi)
+    payload = bytearray()
+    pages: dict[str, PageMeta] = {}
+
+    def put(name: str, arr: np.ndarray):
+        page, crc = codec_mod.encode(arr, codec)
+        pages[name] = PageMeta(
+            offset=base_offset + len(payload),
+            length=len(page),
+            dtype=arr.dtype.str,
+            shape=tuple(arr.shape),
+            codec=codec,
+            crc=crc,
+        )
+        payload.extend(page)
+
+    for name in SPAN_COLUMNS:
+        put(name, batch.cols[name][lo:hi])
+    for name in ATTR_COLUMNS:
+        arr = batch.attrs[name][amask]
+        if name == "attr_span":
+            arr = (arr - np.uint32(lo)).astype(np.uint32)
+        put(name, arr)
+
+    t = batch.cols["trace_id"]
+    start = int(batch.cols["start_unix_nano"][lo:hi].min()) // 10**9 if n else 0
+    end_nano = (batch.cols["start_unix_nano"][lo:hi] + batch.cols["duration_nano"][lo:hi]).max() if n else 0
+    tid = t[lo:hi]
+    n_traces = int((tid[1:] != tid[:-1]).any(axis=1).sum()) + 1 if n else 0
+    meta = RowGroupMeta(
+        n_spans=n,
+        n_attrs=int(amask.sum()),
+        min_id=id_to_hex(t[lo]),
+        max_id=id_to_hex(t[hi - 1]),
+        start_s=start,
+        end_s=int(end_nano) // 10**9 + 1 if n else 0,
+        n_traces=n_traces,
+        pages=pages,
+    )
+    return bytes(payload), meta
+
+
+def decode_columns(reader, rg: RowGroupMeta, names: list[str]) -> dict[str, np.ndarray]:
+    """Fetch+decode selected column pages of one row group.
+
+    reader: callable (offset, length) -> bytes (ranged backend read).
+    """
+    out = {}
+    for name in names:
+        pm = rg.pages[name]
+        page = reader(pm.offset, pm.length)
+        out[name] = codec_mod.decode(page, pm.dtype, pm.shape, pm.codec, pm.crc)
+    return out
+
+
+def row_group_slices(batch: SpanBatch, target_spans: int) -> list[tuple[int, int]]:
+    """Split a trace-sorted batch into [lo,hi) row-group ranges at trace
+    boundaries, each ~target_spans (reference analog: RowGroupSizeBytes
+    flush points, vparquet/compactor.go:160-175)."""
+    n = batch.num_spans
+    if n == 0:
+        return []
+    firsts, _ = batch.trace_boundaries()
+    slices = []
+    lo = 0
+    for i, f in enumerate(firsts):
+        nxt = firsts[i + 1] if i + 1 < len(firsts) else n
+        if nxt - lo >= target_spans:
+            slices.append((lo, int(nxt)))
+            lo = int(nxt)
+    if lo < n:
+        slices.append((lo, n))
+    return slices
+
+
+# ---------------------------------------------------------------------------
+# standalone batch segments (WAL, network pushes)
+# ---------------------------------------------------------------------------
+
+
+def serialize_batch(batch: SpanBatch, codec: str = "zlib") -> bytes:
+    """Self-contained segment: MAGIC | u32 header_len | header json | pages.
+
+    The WAL appends one segment per trace-cut flush
+    (reference analog: vparquet WAL writes one parquet file per flush,
+    tempodb/encoding/vparquet/wal_block.go:309-386).
+    """
+    pages = []
+    header_cols = {}
+    for group, schema in (("cols", SPAN_COLUMNS), ("attrs", ATTR_COLUMNS)):
+        src = getattr(batch, group)
+        for name in schema:
+            arr = src[name]
+            page, crc = codec_mod.encode(arr, codec)
+            header_cols[f"{group}.{name}"] = {
+                "len": len(page),
+                "dtype": arr.dtype.str,
+                "shape": list(arr.shape),
+                "codec": codec,
+                "crc": crc,
+            }
+            pages.append(page)
+    dict_bytes = serialize_dictionary(batch.dictionary)
+    header = json.dumps({"columns": header_cols, "dict_len": len(dict_bytes)}).encode()
+    out = bytearray()
+    out += MAGIC
+    out += struct.pack("<I", len(header))
+    out += header
+    for p in pages:
+        out += p
+    out += dict_bytes
+    return bytes(out)
+
+
+def deserialize_batch(raw: bytes) -> SpanBatch:
+    if raw[: len(MAGIC)] != MAGIC:
+        raise codec_mod.CorruptPage("bad segment magic")
+    hlen = struct.unpack("<I", raw[len(MAGIC) : len(MAGIC) + 4])[0]
+    off = len(MAGIC) + 4
+    header = json.loads(raw[off : off + hlen])
+    off += hlen
+    cols, attrs = {}, {}
+    for key, cm in header["columns"].items():
+        page = raw[off : off + cm["len"]]
+        off += cm["len"]
+        arr = codec_mod.decode(page, cm["dtype"], tuple(cm["shape"]), cm["codec"], cm["crc"])
+        group, name = key.split(".", 1)
+        (cols if group == "cols" else attrs)[name] = arr
+    d = deserialize_dictionary(raw[off : off + header["dict_len"]])
+    return SpanBatch(cols=cols, attrs=attrs, dictionary=d)

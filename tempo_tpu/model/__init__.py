@@ -1,0 +1,18 @@
+"""Trace data model.
+
+Two representations with explicit conversion at the API boundary only:
+
+- `columnar.SpanBatch` — the canonical structure-of-arrays form used by
+  every internal stage (ingest buffers, WAL, blocks, query operands,
+  kernels). Strings are dictionary codes; IDs are uint32 limb arrays.
+- `trace.Trace`/`trace.Span` — object form for protocol boundaries
+  (OTLP ingest, JSON responses, trace combination for by-ID queries).
+
+This replaces the reference's pkg/model (versioned SegmentDecoder /
+ObjectDecoder over protobuf, pkg/model/object_decoder.go:21) — instead of
+proto bytes with version headers, segments are columnar batches
+serialized by the encoding layer.
+"""
+
+from tempo_tpu.model.columnar import Dictionary, SpanBatch  # noqa: F401
+from tempo_tpu.model.trace import Span, Trace  # noqa: F401

@@ -1,0 +1,100 @@
+"""Backend layer tests (local + mock), mirroring the reference's
+backend tests against tmpdirs (SURVEY.md section 4.1)."""
+
+import pytest
+
+from tempo_tpu.backend import (
+    BlockMeta,
+    LocalBackend,
+    MockBackend,
+    NotFound,
+    TypedBackend,
+)
+from tempo_tpu.backend import tenantindex as ti
+
+
+@pytest.fixture(params=["local", "mock"])
+def raw(request, tmp_path):
+    if request.param == "local":
+        return LocalBackend(str(tmp_path / "backend"))
+    return MockBackend()
+
+
+class TestRaw:
+    def test_write_read_roundtrip(self, raw):
+        raw.write("data.bin", ("t1", "b1"), b"hello world")
+        assert raw.read("data.bin", ("t1", "b1")) == b"hello world"
+        assert raw.read_range("data.bin", ("t1", "b1"), 6, 5) == b"world"
+
+    def test_append(self, raw):
+        raw.append("data.bin", ("t1", "b1"), b"aaa")
+        raw.append("data.bin", ("t1", "b1"), b"bbb")
+        assert raw.read("data.bin", ("t1", "b1")) == b"aaabbb"
+
+    def test_not_found(self, raw):
+        with pytest.raises(NotFound):
+            raw.read("nope", ("t1", "b1"))
+        with pytest.raises(NotFound):
+            raw.delete("nope", ("t1", "b1"))
+
+    def test_list(self, raw):
+        raw.write("meta.json", ("t1", "b1"), b"{}")
+        raw.write("meta.json", ("t1", "b2"), b"{}")
+        raw.write("meta.json", ("t2", "b3"), b"{}")
+        assert raw.list(()) == ["t1", "t2"]
+        assert raw.list(("t1",)) == ["b1", "b2"]
+        assert raw.list_objects(("t1", "b1")) == ["meta.json"]
+
+    def test_tenant_level_object_not_a_block(self, raw):
+        raw.write("index.json.gz", ("t1",), b"x")
+        raw.write("meta.json", ("t1", "b1"), b"{}")
+        assert raw.list(("t1",)) == ["b1"]
+
+    def test_overwrite(self, raw):
+        raw.write("x", ("t", "b"), b"1")
+        raw.write("x", ("t", "b"), b"22")
+        assert raw.read("x", ("t", "b")) == b"22"
+
+
+class TestTyped:
+    def test_meta_lifecycle(self, raw):
+        be = TypedBackend(raw)
+        meta = BlockMeta(tenant_id="t1", total_objects=5, min_id="0" * 32, max_id="f" * 32)
+        be.write_block_meta(meta)
+        got = be.block_meta("t1", meta.block_id)
+        assert got.total_objects == 5
+        assert got.block_id == meta.block_id
+
+        be.mark_block_compacted("t1", meta.block_id, now=123.0)
+        with pytest.raises(NotFound):
+            be.block_meta("t1", meta.block_id)
+        cm = be.compacted_block_meta("t1", meta.block_id)
+        assert cm.compacted_time == 123.0
+        assert cm.meta.total_objects == 5
+
+        be.clear_block("t1", meta.block_id)
+        with pytest.raises(NotFound):
+            be.compacted_block_meta("t1", meta.block_id)
+
+    def test_meta_json_roundtrip_ignores_unknown(self):
+        meta = BlockMeta(tenant_id="t", bloom_shards=3, bloom_k=7)
+        raw = meta.to_json()
+        import json
+
+        d = json.loads(raw)
+        d["future_field"] = "xyz"
+        back = BlockMeta.from_json(json.dumps(d).encode())
+        assert back.bloom_shards == 3 and back.bloom_k == 7
+
+
+class TestTenantIndex:
+    def test_roundtrip(self, raw):
+        idx = ti.TenantIndex(
+            metas=[BlockMeta(tenant_id="t", block_id="b1")],
+            compacted=[],
+        )
+        ti.write_tenant_index(raw, "t", idx)
+        back = ti.read_tenant_index(raw, "t")
+        assert back.metas[0].block_id == "b1"
+        assert not ti.is_stale(back, max_age_s=3600)
+        assert ti.is_stale(ti.TenantIndex(created_at=0.0), max_age_s=1)

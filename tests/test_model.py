@@ -1,0 +1,149 @@
+"""Columnar model tests: object<->columnar round trips, dictionary
+remaps, concat, padding, deterministic generation."""
+
+import numpy as np
+
+from tempo_tpu.model import Dictionary, SpanBatch
+from tempo_tpu.model import synth, trace as tr
+from tempo_tpu.model.columnar import VT_STR
+
+
+class TestDictionary:
+    def test_basics(self):
+        d = Dictionary()
+        assert d.add("") == 0
+        a = d.add("hello")
+        assert d.add("hello") == a
+        assert d[a] == "hello"
+        assert d.get("nope") is None
+
+    def test_remap(self):
+        a = Dictionary()
+        ka = [a.add(s) for s in ["x", "y", "z"]]
+        b = Dictionary()
+        kb = [b.add(s) for s in ["y", "w"]]
+        table = b.remap_onto(a)
+        assert a[table[kb[0]]] == "y"
+        assert a[table[kb[1]]] == "w"
+        assert table[0] == 0  # empty string stays 0
+
+
+class TestRoundTrip:
+    def test_object_columnar_object(self):
+        traces = synth.make_traces(5, seed=42)
+        batch = tr.traces_to_batch(traces)
+        assert batch.num_spans == sum(t.span_count() for t in traces)
+        back = tr.batch_to_traces(batch)
+        orig = {t.trace_id: t for t in traces}
+        assert set(orig) == {t.trace_id for t in back}
+        for t2 in back:
+            t1 = orig[t2.trace_id]
+            spans1 = {s.span_id: s for s in t1.all_spans()}
+            spans2 = {s.span_id: s for s in t2.all_spans()}
+            assert set(spans1) == set(spans2)
+            for sid, s1 in spans1.items():
+                s2 = spans2[sid]
+                assert s1.name == s2.name
+                assert s1.start_unix_nano == s2.start_unix_nano
+                assert s1.duration_nano == s2.duration_nano
+                assert s1.kind == s2.kind
+                assert s1.status_code == s2.status_code
+                assert s1.attributes == s2.attributes
+
+    def test_resource_attrs_survive(self):
+        traces = synth.make_traces(3, seed=7)
+        back = tr.batch_to_traces(tr.traces_to_batch(traces))
+        for t in back:
+            for resource, _ in t.batches:
+                assert resource["cluster"] == "test"
+                assert "service.name" in resource
+
+
+class TestBatchOps:
+    def test_concat_remaps_codes(self):
+        b1 = tr.traces_to_batch(synth.make_traces(3, seed=1))
+        b2 = tr.traces_to_batch(synth.make_traces(3, seed=2))
+        merged = SpanBatch.concat([b1, b2])
+        assert merged.num_spans == b1.num_spans + b2.num_spans
+        # names decoded through the merged dictionary match the originals
+        for src, off in ((b1, 0), (b2, b1.num_spans)):
+            for i in range(src.num_spans):
+                assert (
+                    merged.dictionary[int(merged.cols["name"][off + i])]
+                    == src.dictionary[int(src.cols["name"][i])]
+                )
+        # attr strings too
+        got = {
+            (int(r), merged.dictionary[int(k)])
+            for r, k in zip(merged.attrs["attr_span"], merged.attrs["attr_key"])
+        }
+        want = {
+            (int(r), b1.dictionary[int(k)])
+            for r, k in zip(b1.attrs["attr_span"], b1.attrs["attr_key"])
+        } | {
+            (int(r) + b1.num_spans, b2.dictionary[int(k)])
+            for r, k in zip(b2.attrs["attr_span"], b2.attrs["attr_key"])
+        }
+        assert got == want
+
+    def test_select_filters_attrs(self):
+        b = tr.traces_to_batch(synth.make_traces(2, seed=3))
+        idx = np.arange(b.num_spans // 2)
+        sel = b.select(idx)
+        assert sel.num_spans == len(idx)
+        assert (sel.attrs["attr_span"] < sel.num_spans).all()
+        back_full = tr.batch_to_traces(b)
+        spans_with_attrs = {s.span_id for t in back_full for s in t.all_spans() if s.attributes}
+        assert spans_with_attrs  # sanity: generator always attaches attrs
+
+    def test_sorted_by_trace_groups_rows(self):
+        batch = synth.make_batch(10, 5, seed=4)
+        t = batch.cols["trace_id"]
+        rows = [tuple(r) for r in t.tolist()]
+        assert rows == sorted(rows)
+        firsts, seg = batch.trace_boundaries()
+        assert len(firsts) == 10
+        assert seg.max() == 9
+
+    def test_pad_and_validate(self):
+        b = synth.make_batch(4, 4, seed=5)
+        padded, valid = b.pad_to(64)
+        assert padded.num_spans == 64
+        assert valid.sum() == 16
+        b.validate()
+
+    def test_empty_batch(self):
+        b = SpanBatch()
+        assert b.num_spans == 0
+        assert SpanBatch.concat([]).num_spans == 0
+
+
+class TestCombine:
+    def test_combine_dedupes(self):
+        t = synth.make_trace(seed=9, n_spans=10)
+        # split into two partials with overlap (RF=2 behavior)
+        spans = list(t.all_spans())
+        t1 = tr.Trace(trace_id=t.trace_id, batches=[(t.batches[0][0], spans[:7])])
+        t2 = tr.Trace(trace_id=t.trace_id, batches=[(t.batches[0][0], spans[4:])])
+        combined = tr.combine_traces([t1, t2])
+        assert combined.span_count() == 10
+
+    def test_combine_none(self):
+        assert tr.combine_traces([]) is None
+        assert tr.combine_traces([None]) is None
+
+
+class TestSynthDeterminism:
+    def test_same_seed_same_trace(self):
+        a = synth.make_trace(seed=123)
+        b = synth.make_trace(seed=123)
+        assert a.trace_id == b.trace_id
+        sa = {s.span_id: s.attributes for s in a.all_spans()}
+        sb = {s.span_id: s.attributes for s in b.all_spans()}
+        assert sa == sb
+
+    def test_make_batch_deterministic(self):
+        a = synth.make_batch(5, 3, seed=6)
+        b = synth.make_batch(5, 3, seed=6)
+        for k in a.cols:
+            assert np.array_equal(a.cols[k], b.cols[k])
