@@ -1,0 +1,77 @@
+"""Benchmark: compaction-kernel span throughput on the local accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": R}
+
+Measures the hot path of vtpu1 block compaction — the device merge plan
+(lexsort by 128-bit trace ID + span ID, duplicate masking) plus sharded
+bloom construction and HLL/count-min sketch updates — over a 2M-span
+batch, steady-state (post-compile), and compares against the same
+logical work done by the single-threaded numpy mirror (the CPU
+row-merge baseline standing in for the reference's Go compactor loop,
+tempodb/encoding/vparquet/compactor.go).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from tempo_tpu.ops import merge
+    from tempo_tpu.parallel.compaction import default_plans, local_compaction_step
+
+    n = 1 << 21  # ~2M spans
+    rng = np.random.default_rng(42)
+    tids_np = rng.integers(0, 2**32, (n, 4), np.uint32)
+    sids_np = rng.integers(0, 2**32, (n, 2), np.uint32)
+    # 25% duplicated rows: the RF>1 dedupe workload
+    k = n // 4
+    tids_np[:k] = tids_np[k : 2 * k]
+    sids_np[:k] = sids_np[k : 2 * k]
+
+    plans = default_plans(n)
+    step = jax.jit(lambda t, s: local_compaction_step(t, s, None, plans, axis=None))
+
+    tids = jnp.asarray(tids_np)
+    sids = jnp.asarray(sids_np)
+    out = step(tids, sids)  # compile + warm
+    int(np.asarray(out["n_rows"]))  # host fetch: block_until_ready is not
+    # reliable on the experimental axon platform, a transfer is
+
+    runs = 3
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        out = step(tids, sids)
+        int(np.asarray(out["n_rows"]))
+    dt = (time.perf_counter() - t0) / runs
+    device_spans_per_s = n / dt
+
+    # single-threaded numpy baseline: merge plan + bloom-bit computation +
+    # register updates are dominated by the lexsort; np mirror of the plan
+    # is the honest floor (one run; it is slow).
+    t0 = time.perf_counter()
+    merge.np_merge_spans(tids_np, sids_np)
+    base_dt = time.perf_counter() - t0
+    base_spans_per_s = n / base_dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "compaction_kernel_span_throughput",
+                "value": round(device_spans_per_s),
+                "unit": "spans/s",
+                "vs_baseline": round(device_spans_per_s / base_spans_per_s, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,153 @@
+"""Per-tenant in-memory blocklist + backend poller.
+
+Reference: tempodb/blocklist/list.go:17 (List with in-flight compaction
+reconciliation, updateInternal:123) and poller.go:122 (scan bucket or
+read per-tenant index.json.gz; designated builders write the index;
+staleness fallback :284).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from tempo_tpu.backend.base import (
+    BlockMeta,
+    CompactedBlockMeta,
+    CompactedMetaName,
+    MetaName,
+    NotFound,
+    TypedBackend,
+)
+from tempo_tpu.backend.tenantindex import (
+    TenantIndex,
+    is_stale,
+    read_tenant_index,
+    write_tenant_index,
+)
+
+log = logging.getLogger(__name__)
+
+
+class Blocklist:
+    """Thread-safe per-tenant lists of live + compacted block metas."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metas: dict[str, list[BlockMeta]] = {}
+        self._compacted: dict[str, list[CompactedBlockMeta]] = {}
+
+    def tenants(self) -> list[str]:
+        with self._lock:
+            return [t for t, m in self._metas.items() if m]
+
+    def compacted_tenants(self) -> list[str]:
+        with self._lock:
+            return [t for t, c in self._compacted.items() if c]
+
+    def metas(self, tenant: str) -> list[BlockMeta]:
+        with self._lock:
+            return list(self._metas.get(tenant, []))
+
+    def compacted_metas(self, tenant: str) -> list[CompactedBlockMeta]:
+        with self._lock:
+            return list(self._compacted.get(tenant, []))
+
+    def apply_poll_results(self, metas, compacted):
+        with self._lock:
+            self._metas = {t: list(v) for t, v in metas.items()}
+            self._compacted = {t: list(v) for t, v in compacted.items()}
+
+    def update(self, tenant, adds=(), removes=(), compacted_adds=()):
+        """In-flight reconciliation between polls: the compactor updates
+        the list immediately after a job so queries and the next selector
+        cycle see the new world (reference: updateInternal:123)."""
+        with self._lock:
+            cur = self._metas.setdefault(tenant, [])
+            rm_ids = {m.block_id for m in removes}
+            cur[:] = [m for m in cur if m.block_id not in rm_ids]
+            have = {m.block_id for m in cur}
+            cur.extend(m for m in adds if m.block_id not in have)
+            cc = self._compacted.setdefault(tenant, [])
+            have_c = {c.meta.block_id for c in cc}
+            cc.extend(c for c in compacted_adds if c.meta.block_id not in have_c)
+
+    def drop_compacted(self, tenant, block_ids):
+        """Forget compacted entries whose objects were cleared (retention
+        phase 2), so they aren't re-cleared every cycle until the next poll."""
+        ids = set(block_ids)
+        with self._lock:
+            cc = self._compacted.get(tenant, [])
+            cc[:] = [c for c in cc if c.meta.block_id not in ids]
+
+
+class Poller:
+    """Scans the backend into poll results; optionally builds the
+    per-tenant index when this instance is a designated builder."""
+
+    def __init__(self, backend: TypedBackend, build_index: bool = False,
+                 stale_tenant_index_s: float = 0.0, pool=None):
+        self.backend = backend
+        self.build_index = build_index
+        self.stale_tenant_index_s = stale_tenant_index_s
+        self.pool = pool
+
+    def do(self):
+        """-> (metas: {tenant: [BlockMeta]}, compacted: {tenant: [CompactedBlockMeta]})"""
+        metas, compacted = {}, {}
+        for tenant in self.backend.tenants():
+            m, c = self._poll_tenant(tenant)
+            metas[tenant] = m
+            compacted[tenant] = c
+        return metas, compacted
+
+    def _poll_tenant(self, tenant: str):
+        if not self.build_index:
+            try:
+                idx = read_tenant_index(self.backend.raw, tenant)
+                if not is_stale(idx, self.stale_tenant_index_s):
+                    return idx.metas, idx.compacted
+                log.warning("tenant index for %s is stale; falling back to scan", tenant)
+            except NotFound:
+                pass
+            except Exception as e:
+                log.warning("tenant index read failed for %s: %s", tenant, e)
+        m, c = self._scan_tenant(tenant)
+        if self.build_index:
+            try:
+                write_tenant_index(
+                    self.backend.raw, tenant, TenantIndex(created_at=time.time(), metas=m, compacted=c)
+                )
+            except Exception as e:
+                log.warning("tenant index write failed for %s: %s", tenant, e)
+        return m, c
+
+    def _scan_tenant(self, tenant: str):
+        metas, compacted = [], []
+
+        def load(block_id):
+            try:
+                return ("live", self.backend.block_meta(tenant, block_id))
+            except NotFound:
+                pass
+            try:
+                return ("compacted", self.backend.compacted_block_meta(tenant, block_id))
+            except NotFound:
+                return None  # mid-write block without meta yet
+
+        block_ids = self.backend.blocks(tenant)
+        if self.pool is not None:
+            results, errors = self.pool.run_jobs([lambda b=b: load(b) for b in block_ids])
+            if errors:
+                # a transient meta-read failure must abort the poll (keeping
+                # the previous blocklist) rather than silently dropping the
+                # block from query visibility
+                raise errors[0]
+        else:
+            results = [r for r in (load(b) for b in block_ids) if r is not None]
+        for kind, meta in results:
+            (metas if kind == "live" else compacted).append(meta)
+        metas.sort(key=lambda m: m.block_id)
+        compacted.sort(key=lambda c: c.meta.block_id)
+        return metas, compacted

@@ -1,0 +1,159 @@
+"""Compaction scheduling: time-window block selection + driver.
+
+Reference: tempodb/compaction_block_selector.go:48-160
+(timeWindowBlockSelector: bucket blocks by compaction level + time
+window, group 2..4 blocks per job with object/byte caps, job hash
+"tenant-level-window-minID-maxID" for ring ownership) and
+tempodb/compactor.go:66-258 (per-cycle tenant round-robin, compact,
+mark-compacted, blocklist update).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+
+from tempo_tpu.backend.base import BlockMeta, CompactedBlockMeta
+
+log = logging.getLogger(__name__)
+
+DEFAULT_INPUT_BLOCKS = 2  # reference: tempodb/compactor.go:21-23
+MAX_COMPACTION_RANGE = 4
+
+
+@dataclass
+class CompactionConfig:
+    window_s: int = 3600  # reference default compaction window 1h
+    max_input_blocks: int = MAX_COMPACTION_RANGE
+    max_objects: int = 6_000_000
+    max_bytes: int = 100 * 1024**3
+    cycle_s: float = 30.0
+    retention_s: float = 14 * 24 * 3600
+    compacted_retention_s: float = 3600
+
+
+class TimeWindowBlockSelector:
+    """Yields (blocks_to_compact, job_hash) groups, highest-priority first."""
+
+    def __init__(self, metas: list[BlockMeta], cfg: CompactionConfig):
+        self.cfg = cfg
+        self._groups = self._plan(list(metas))
+
+    def _window(self, m: BlockMeta) -> int:
+        return m.end_time // self.cfg.window_s
+
+    def _plan(self, metas):
+        now_window = int(time.time()) // self.cfg.window_s
+        # active window: group by (level, window); older windows: by window only
+        # (reference compacts across levels once a window has gone cold)
+        buckets: dict[tuple, list[BlockMeta]] = {}
+        for m in metas:
+            w = self._window(m)
+            key = (m.compaction_level, w) if w >= now_window else (-1, w)
+            buckets.setdefault(key, []).append(m)
+        groups = []
+        for (level, w), blocks in buckets.items():
+            blocks.sort(key=lambda m: (m.min_id, m.block_id))
+            i = 0
+            while i + 1 < len(blocks):
+                group = [blocks[i]]
+                objs = blocks[i].total_objects
+                size = blocks[i].size_bytes
+                j = i + 1
+                while (
+                    j < len(blocks)
+                    and len(group) < self.cfg.max_input_blocks
+                    and objs + blocks[j].total_objects <= self.cfg.max_objects
+                    and size + blocks[j].size_bytes <= self.cfg.max_bytes
+                ):
+                    group.append(blocks[j])
+                    objs += blocks[j].total_objects
+                    size += blocks[j].size_bytes
+                    j += 1
+                if len(group) >= 2:
+                    h = f"{group[0].tenant_id}-{level}-{w}-{group[0].min_id}-{group[-1].max_id}"
+                    groups.append((group, h))
+                i = j
+        # oldest windows first, lower levels first (reference sort semantics)
+        groups.sort(key=lambda g: (self._window(g[0][0]), g[0][0].compaction_level))
+        return groups
+
+    def blocks_to_compact(self):
+        """Pop the next group or ([], '')."""
+        if self._groups:
+            return self._groups.pop(0)
+        return [], ""
+
+
+@dataclass
+class CompactionMetrics:
+    jobs: int = 0
+    blocks_in: int = 0
+    blocks_out: int = 0
+    objects_written: int = 0
+    bytes_written: int = 0
+    spans_dropped: int = 0
+    errors: int = 0
+
+
+class CompactionDriver:
+    """One engine-side compaction worker; roles decide ownership.
+
+    owns(job_hash) -> bool comes from the compactor module's ring sharder
+    (reference: modules/compactor/compactor.go:189-217); default owns all.
+    """
+
+    def __init__(self, db, cfg: CompactionConfig | None = None, owns=None):
+        self.db = db
+        self.cfg = cfg or CompactionConfig()
+        self.owns = owns or (lambda h: True)
+        self.metrics = CompactionMetrics()
+        self._tenant_rr = 0
+
+    def run_one_cycle(self) -> int:
+        """Pick one tenant round-robin, compact all owned groups once.
+        Returns number of jobs run (reference: doCompaction:78)."""
+        tenants = self.db.blocklist.tenants()
+        if not tenants:
+            return 0
+        tenant = tenants[self._tenant_rr % len(tenants)]
+        self._tenant_rr += 1
+        return self.compact_tenant(tenant)
+
+    def compact_tenant(self, tenant: str, max_jobs: int = 0) -> int:
+        selector = TimeWindowBlockSelector(self.db.blocklist.metas(tenant), self.cfg)
+        jobs = 0
+        while True:
+            group, job_hash = selector.blocks_to_compact()
+            if not group:
+                break
+            if not self.owns(job_hash):
+                continue
+            try:
+                self.compact_blocks(tenant, group)
+                jobs += 1
+            except Exception:
+                self.metrics.errors += 1
+                log.exception("compaction job %s failed", job_hash)
+            if max_jobs and jobs >= max_jobs:
+                break
+        return jobs
+
+    def compact_blocks(self, tenant: str, group: list[BlockMeta]):
+        enc = self.db.encoding_for(group[0].version)
+        compactor = enc.new_compactor(self.db.compaction_options())
+        new_metas = compactor.compact(group, tenant, self.db.backend)
+        now = time.time()
+        compacted = []
+        for m in group:
+            self.db.backend.mark_block_compacted(tenant, m.block_id, now)
+            compacted.append(CompactedBlockMeta(meta=m, compacted_time=now))
+        self.db.blocklist.update(tenant, adds=new_metas, removes=group, compacted_adds=compacted)
+        self.metrics.jobs += 1
+        self.metrics.blocks_in += len(group)
+        self.metrics.blocks_out += len(new_metas)
+        self.metrics.objects_written += sum(m.total_objects for m in new_metas)
+        self.metrics.bytes_written += sum(m.size_bytes for m in new_metas)
+        self.metrics.spans_dropped += getattr(compactor, "spans_dropped", 0)
+        return new_metas

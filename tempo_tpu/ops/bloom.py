@@ -156,6 +156,21 @@ def merge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return a | b
 
 
+def psum_merge(words: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """OR-merge partial blooms across a mesh axis using psum over ICI.
+
+    psum adds words, which is not OR — so expand words to per-bit 0/1
+    lanes, psum those (sum > 0 == OR for bits), and repack. This is the
+    BASELINE.json north-star collective: per-shard partial blooms from a
+    sharded compaction merge into the block's final filter without
+    leaving the device mesh.
+    """
+    shifts = jnp.arange(_WORD_BITS, dtype=jnp.uint32)
+    bits = (words[..., None] >> shifts) & jnp.uint32(1)
+    summed = jax.lax.psum(bits, axis_name)
+    return jnp.sum((summed > 0).astype(jnp.uint32) << shifts, axis=-1, dtype=jnp.uint32)
+
+
 def shard_for_ids(limbs: np.ndarray, p: BloomPlan) -> np.ndarray:
     """Host-side: which bloom shard object holds each ID (numpy)."""
     return (hashing.np_fnv1a_32(limbs) % np.uint32(p.n_shards)).astype(np.uint32)

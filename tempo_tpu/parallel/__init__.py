@@ -1,0 +1,13 @@
+"""Device-mesh parallelism: the TPU re-expression of the reference's
+sharding schemes (SURVEY.md section 2.8).
+
+- P3/P5 (blockID-space and compaction sharding) -> ID-range sharding
+  over a mesh axis, shard-local sort/dedupe, psum/pmax sketch merges
+  over ICI (parallel.compaction).
+- P4 (search page sharding) -> row-group batches sharded over devices
+  (parallel.search).
+- Multi-host: the same shard_map programs run under jax.distributed with
+  a DCN-connected mesh; the control plane (rings/queues) stays host-side.
+"""
+
+from tempo_tpu.parallel.mesh import get_mesh, mesh_shape_for  # noqa: F401

@@ -1,0 +1,160 @@
+"""Sharded compaction: ID-range shards over the mesh, psum sketch merges.
+
+The BASELINE.json north star. How it maps:
+
+1. Host splits the input blocks' span rows into R shards by uniform
+   128-bit trace-ID ranges (shard = traceID_hi * R >> 32) — the same
+   uniform blockID-space split the reference frontend uses for
+   trace-by-ID sharding (modules/frontend/tracebyidsharding.go:228).
+   Because shards partition the ID space, per-shard sort/dedupe is
+   globally correct: concatenating shard outputs in order yields the
+   fully merged block.
+2. Each device runs the local merge kernel (ops.merge: lexsort +
+   first-occurrence dedupe) plus bloom/HLL/count-min partials over its
+   shard.
+3. Partials merge across the "range" axis with collectives over ICI:
+   bloom via psum-clamp (ops.bloom.psum_merge), HLL via pmax, counts +
+   count-min via psum. Every device exits with the block-global
+   sketches; the host reads them from shard 0.
+
+A second optional "window" mesh axis runs independent compaction
+windows side by side (reference P5: windows are independent jobs), with
+no collectives crossing it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map as _shard_map_mod
+
+    shard_map = jax.shard_map
+except (ImportError, AttributeError):  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from tempo_tpu.ops import bloom, merge, sketch
+from tempo_tpu.parallel.mesh import RANGE_AXIS, WINDOW_AXIS
+
+
+@dataclass(frozen=True)
+class CompactionPlans:
+    bloom: bloom.BloomPlan
+    hll: sketch.HLLPlan
+    cm: sketch.CMPlan
+
+
+def default_plans(n_traces_hint: int = 1 << 16, fp: float = 0.01) -> CompactionPlans:
+    return CompactionPlans(
+        bloom=bloom.plan(n_traces_hint, fp),
+        hll=sketch.HLLPlan(12),
+        cm=sketch.CMPlan(4, 1 << 12),
+    )
+
+
+def local_compaction_step(tids, sids, valid, plans: CompactionPlans, axis: str | None):
+    """Per-device compaction math; runs inside shard_map (axis set) or
+    single-device (axis None — collectives skipped; this is also the
+    single-chip flagship step that __graft_entry__.entry() exposes).
+
+    tids (N,4) uint32, sids (N,2) uint32, valid (N,) bool.
+    """
+    plan = merge.merge_spans(tids, sids, valid)
+    perm, keep = plan["perm"], plan["keep"]
+    st = tids[perm]
+    # first occurrence of each unique trace among surviving rows
+    trace_first = merge.first_occurrence_mask(st, valid[perm] if valid is not None else None) & keep
+
+    words = bloom.build(st, plans.bloom, valid=trace_first)
+    regs = sketch.hll_update(sketch.hll_init(plans.hll), st, plans.hll, valid=trace_first)
+    # span count per trace id (hot-trace detection feeds max_spans_per_trace)
+    counts = sketch.cm_update(sketch.cm_init(plans.cm), st, plans.cm, valid=keep)
+    n_rows = plan["n_rows"]
+    n_traces = plan["n_traces"]
+
+    if axis is not None:
+        words = bloom.psum_merge(words, axis)
+        regs = jax.lax.pmax(regs, axis)
+        counts = jax.lax.psum(counts, axis)
+        total_rows = jax.lax.psum(n_rows, axis)
+        total_traces = jax.lax.psum(n_traces, axis)
+    else:
+        total_rows, total_traces = n_rows, n_traces
+
+    return {
+        "perm": perm,
+        "keep": keep,
+        "n_rows": n_rows,
+        "n_traces": n_traces,
+        "total_rows": total_rows,
+        "total_traces": total_traces,
+        "bloom": words,
+        "hll": regs,
+        "cm": counts,
+    }
+
+
+def make_sharded_compactor(mesh, plans: CompactionPlans):
+    """Jitted shard_map over (W, R, N, ...) stacked shard inputs.
+
+    Outputs: per-shard merge plans sharded as inputs; sketches and totals
+    replicated across the range axis (one copy per window).
+    """
+
+    def step(tids, sids, valid):
+        # blocks arrive with leading (1, 1) window/range dims; squeeze them
+        out = local_compaction_step(tids[0, 0], sids[0, 0], valid[0, 0], plans, RANGE_AXIS)
+        sharded = {k: out[k][None, None] for k in ("perm", "keep", "n_rows", "n_traces")}
+        replicated = {k: out[k][None] for k in ("total_rows", "total_traces", "bloom", "hll", "cm")}
+        return sharded, replicated
+
+    spec_in = P(WINDOW_AXIS, RANGE_AXIS)
+    return jax.jit(
+        shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(spec_in, spec_in, spec_in),
+            out_specs=(P(WINDOW_AXIS, RANGE_AXIS), P(WINDOW_AXIS)),
+            check_vma=False,
+        )
+    )
+
+
+def partition_by_id_range(tids: np.ndarray, sids: np.ndarray, r: int,
+                          pad_to: int | None = None):
+    """Host-side split of span rows into R uniform trace-ID ranges.
+
+    -> (tids (R,N,4), sids (R,N,2), valid (R,N), row_index (R,N) int64)
+    row_index maps shard rows back to input rows (-1 for padding) so the
+    host can gather payload columns per shard after the device pass.
+    """
+    n = tids.shape[0]
+    shard = ((tids[:, 0].astype(np.uint64) * np.uint64(r)) >> np.uint64(32)).astype(np.int64)
+    order = np.argsort(shard, kind="stable")
+    sizes = np.bincount(shard, minlength=r)
+    cap = int(sizes.max()) if n else 1
+    if pad_to is not None:
+        if pad_to < cap:
+            raise ValueError(f"pad_to={pad_to} < largest shard {cap}")
+        cap = pad_to
+    t_out = np.zeros((r, cap, 4), np.uint32)
+    s_out = np.zeros((r, cap, 2), np.uint32)
+    valid = np.zeros((r, cap), bool)
+    ridx = np.full((r, cap), -1, np.int64)
+    off = 0
+    for s in range(r):
+        k = int(sizes[s])
+        rows = order[off : off + k]
+        off += k
+        t_out[s, :k] = tids[rows]
+        s_out[s, :k] = sids[rows]
+        valid[s, :k] = True
+        ridx[s, :k] = rows
+    return t_out, s_out, valid, ridx

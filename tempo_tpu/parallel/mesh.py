@@ -1,0 +1,37 @@
+"""Mesh construction helpers.
+
+One mesh, up to two axes:
+- "range": block/ID-range shards (collectives ride ICI) — the axis
+  sketch/bloom merges reduce over;
+- "window": independent compaction windows / job parallelism (no
+  collectives cross it).
+
+Mirrors how the reference splits work: windows are independent jobs
+(P5), ranges within a job share merge state.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+RANGE_AXIS = "range"
+WINDOW_AXIS = "window"
+
+
+def mesh_shape_for(n_devices: int) -> tuple[int, int]:
+    """(window, range) shape: prefer 2 windows when devices allow."""
+    if n_devices >= 4 and n_devices % 2 == 0:
+        return (2, n_devices // 2)
+    return (1, n_devices)
+
+
+def get_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"asked for {n} devices, have {len(devs)}")
+    w, r = mesh_shape_for(n)
+    import numpy as np
+
+    return Mesh(np.asarray(devs[:n]).reshape(w, r), (WINDOW_AXIS, RANGE_AXIS))

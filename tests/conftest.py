@@ -5,17 +5,24 @@ Tests always run on a virtual 8-device CPU mesh so multi-chip sharding
 mirroring how the reference tests its distributed paths with in-process
 rings and local backends (SURVEY.md section 4).
 
-Must run before the first `import jax` anywhere in the test process.
+Note: this environment's TPU plugin (loaded via sitecustomize) calls
+jax.config.update("jax_platforms", ...) at interpreter start, which
+overrides the JAX_PLATFORMS env var — so we must update the config after
+importing jax, not just set the env.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests spawn
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
