@@ -1,0 +1,139 @@
+"""App wiring — single-binary and per-role composition.
+
+Reference: cmd/tempo/app (module manager DAG modules.go:369-423,
+target-based activation, auth middleware). The python composition is
+explicit: App(target="all") builds every role in-process sharing one
+ring + engine, which is exactly what the reference's single binary does
+(process boundaries collapse to in-process calls, SURVEY.md section 3.1).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+from tempo_tpu.db import DBConfig, TempoDB
+from tempo_tpu.encoding.common import SearchRequest
+from tempo_tpu.modules.compactor_module import CompactorModule
+from tempo_tpu.modules.distributor import Distributor
+from tempo_tpu.modules.frontend import Frontend, FrontendConfig
+from tempo_tpu.modules.generator import Generator
+from tempo_tpu.modules.ingester import Ingester, IngesterConfig
+from tempo_tpu.modules.overrides import Limits, Overrides
+from tempo_tpu.modules.querier import Querier
+from tempo_tpu.modules.queue import RequestQueue, WorkerPool
+from tempo_tpu.modules.ring import MemoryKV, Ring
+
+log = logging.getLogger(__name__)
+
+DEFAULT_TENANT = "single-tenant"  # reference: util.FakeTenantID for non-multitenant
+
+
+@dataclass
+class AppConfig:
+    target: str = "all"
+    multitenancy_enabled: bool = False
+    db: DBConfig = field(default_factory=DBConfig)
+    ingester: IngesterConfig = field(default_factory=IngesterConfig)
+    frontend: FrontendConfig = field(default_factory=FrontendConfig)
+    limits: Limits = field(default_factory=Limits)
+    overrides_path: str | None = None
+    replication_factor: int = 1
+    n_ingesters: int = 1  # in-process ingesters (tests use >1 to exercise RF)
+    query_workers: int = 4
+    generator_enabled: bool = True
+
+
+class App:
+    def __init__(self, cfg: AppConfig):
+        self.cfg = cfg
+        self.db = TempoDB(cfg.db)
+        self.overrides = Overrides(cfg.limits, cfg.overrides_path)
+        kv = MemoryKV()
+        self.ring = Ring(kv, replication_factor=cfg.replication_factor)
+
+        # ingesters
+        self.ingesters: dict[str, Ingester] = {}
+        for i in range(cfg.n_ingesters):
+            iid = f"ingester-{i}"
+            # each in-process ingester gets its own WAL subdir (separate
+            # process-equivalents must not share head blocks)
+            sub_cfg = DBConfig(**{**cfg.db.__dict__})
+            sub_cfg.wal_path = (cfg.db.wal_path or "wal") + f"/{iid}"
+            ing_db = TempoDB(sub_cfg, raw_backend=self.db.backend.raw)
+            ing_db.blocklist = self.db.blocklist  # shared world view
+            ing = Ingester(ing_db, self.overrides, cfg.ingester, instance_id=iid)
+            self.ingesters[iid] = ing
+            self.ring.register(iid)
+
+        # generator ring + instances
+        self.generator = None
+        gen_clients = {}
+        self.generator_ring = None
+        if cfg.generator_enabled:
+            self.generator_ring = Ring(MemoryKV(), replication_factor=1)
+            self.generator = Generator(self.overrides, instance_id="generator-0")
+            self.generator_ring.register("generator-0")
+            gen_clients["generator-0"] = self.generator
+
+        self.distributor = Distributor(
+            self.ring,
+            ingester_clients=self.ingesters,
+            overrides=self.overrides,
+            generator_ring=self.generator_ring,
+            generator_clients=gen_clients,
+        )
+        self.querier = Querier(self.db, self.ring, ingester_clients=self.ingesters)
+        self.queue = RequestQueue()
+        self.workers = WorkerPool(self.queue, n_workers=cfg.query_workers)
+        self.frontend = Frontend(self.queue, self.querier, cfg.frontend, self.overrides)
+        self.compactor = CompactorModule(self.db, ring=None)
+
+        # heartbeat every registered member — without this the whole ring
+        # goes unhealthy after heartbeat_timeout_s and ingest stops
+        self._heartbeat_stops = [self.ring.start_heartbeat(iid) for iid in self.ingesters]
+        if self.generator_ring is not None:
+            self._heartbeat_stops.append(self.generator_ring.start_heartbeat("generator-0"))
+
+    # -- tenant resolution ----------------------------------------------
+    def resolve_tenant(self, org_id: str | None) -> str:
+        """Reference: multitenancy via X-Scope-OrgID (app auth middleware)."""
+        if not self.cfg.multitenancy_enabled:
+            return DEFAULT_TENANT
+        if not org_id:
+            raise PermissionError("no org id (X-Scope-OrgID) provided")
+        return org_id
+
+    # -- API surface -----------------------------------------------------
+    def push_traces(self, traces, org_id=None):
+        self.distributor.push_traces(self.resolve_tenant(org_id), traces)
+
+    def find_trace(self, trace_id: bytes, org_id=None):
+        return self.frontend.find_trace_by_id(self.resolve_tenant(org_id), trace_id)
+
+    def search(self, req: SearchRequest, org_id=None):
+        return self.frontend.search(self.resolve_tenant(org_id), req)
+
+    def traceql(self, query: str, org_id=None, **kw):
+        return self.frontend.traceql(self.resolve_tenant(org_id), query, **kw)
+
+    # -- lifecycle -------------------------------------------------------
+    def start_loops(self):
+        for ing in self.ingesters.values():
+            ing.start_loop()
+        self.db.enable_polling()
+        self.compactor.start()
+
+    def sweep_all(self, immediate: bool = False):
+        """Deterministic maintenance for tests/drives."""
+        for ing in self.ingesters.values():
+            ing.sweep(immediate=immediate)
+
+    def shutdown(self):
+        for stop in getattr(self, "_heartbeat_stops", []):
+            stop.set()
+        for ing in self.ingesters.values():
+            ing.stop(flush=True)
+        self.workers.stop()
+        self.compactor.stop()
+        self.db.shutdown()

@@ -187,10 +187,54 @@ class TempoDB:
         blk = self.encoding_for(meta.version).open_block(meta, self.backend, self.cfg.block)
         return blk.search(req)
 
-    def fetch(self, tenant: str, meta, conditions, start_s: int = 0, end_s: int = 0):
-        """TraceQL fetch on one block — wired by the traceql engine."""
-        blk = self.encoding_for(meta.version).open_block(meta, self.backend, self.cfg.block)
-        return blk.fetch(conditions, start_s, end_s)
+    def fetch_candidates(self, tenant: str, spec, start_s: int = 0, end_s: int = 0):
+        """TraceQL candidate fetch across blocks; traces straddling
+        blocks are combined before the engine sees them (aggregates like
+        count() must observe the whole trace)."""
+        metas = [m for m in self.blocklist.metas(tenant) if _overlaps(m, start_s, end_s)]
+
+        def job(meta):
+            blk = self.encoding_for(meta.version).open_block(meta, self.backend, self.cfg.block)
+            return blk.fetch_candidates(spec, start_s, end_s)
+
+        results, errors = self.pool.run_jobs([lambda m=m: job(m) for m in metas])
+        if errors:
+            raise errors[0]
+        by_id: dict[bytes, list] = {}
+        for traces in results:
+            for t in traces:
+                by_id.setdefault(t.trace_id, []).append(t)
+
+        # a candidate trace may straddle blocks where only some blocks'
+        # spans matched the pushdown — re-collect its full span set from
+        # every overlapping block so the engine sees whole traces
+        if by_id and len(metas) > 1:
+            hex_ids = {tid.hex().rjust(32, "0") for tid in by_id}
+
+            def complete(meta):
+                blk = self.encoding_for(meta.version).open_block(meta, self.backend, self.cfg.block)
+                return blk.collect_spans_for_ids(hex_ids)
+
+            full, errors = self.pool.run_jobs([lambda m=m: complete(m) for m in metas])
+            if errors:
+                raise errors[0]
+            by_id = {}
+            for traces in full:
+                for t in traces:
+                    by_id.setdefault(t.trace_id, []).append(t)
+        return [combine_traces(parts) for parts in by_id.values()]
+
+    def traceql_search(self, tenant: str, query: str, start_s: int = 0,
+                       end_s: int = 0, limit: int = 20):
+        """Execute a TraceQL query over this tenant's blocks (reference:
+        traceql.Engine.Execute bridging SearchRequest -> Fetch,
+        pkg/traceql/engine.go:25)."""
+        from tempo_tpu.traceql import execute
+
+        def fetch(spec, s, e):
+            return self.fetch_candidates(tenant, spec, s, e)
+
+        return execute(query, fetch, start_s=start_s, end_s=end_s, limit=limit)
 
     # ------------------------------------------------------------------
     # maintenance

@@ -216,10 +216,10 @@ class VtpuBackendBlock:
         cols.update(self.read_columns(rg, sorted(set(_META_COLS) - set(cols))))
 
         # roll up to traces (any span matched), honoring time window
+        from tempo_tpu.model.columnar import hit_trace_mask, trace_segmentation
+
         tid = cols["trace_id"]
-        new = np.ones(n, bool)
-        new[1:] = (tid[1:] != tid[:-1]).any(axis=1)
-        seg = np.cumsum(new) - 1
+        new, seg, firsts = trace_segmentation(tid)
         starts = cols["start_unix_nano"]
         ends = starts + cols["duration_nano"]
         if req.start_seconds:
@@ -228,11 +228,9 @@ class VtpuBackendBlock:
             span_mask &= starts <= np.uint64(req.end_seconds * 10**9)
 
         n_traces = int(seg[-1]) + 1
-        trace_hit = np.zeros(n_traces, bool)
-        np.logical_or.at(trace_hit, seg[span_mask], True)
+        trace_hit = hit_trace_mask(seg, span_mask, n_traces)
 
         out = []
-        firsts = np.flatnonzero(new)
         d = self.dictionary()
         for t in np.flatnonzero(trace_hit):
             lo = firsts[t]
@@ -255,6 +253,236 @@ class VtpuBackendBlock:
             if limit > 0 and len(out) >= limit:
                 break
         return out
+
+
+    # ------------------------------------------------------------------
+    # TraceQL fetch: approximate condition pushdown -> candidate traces
+    # ------------------------------------------------------------------
+
+    def fetch_candidates(self, spec, start_s: int = 0, end_s: int = 0,
+                         max_traces: int = 0) -> list:
+        """Candidate Trace objects for a TraceQL FetchSpec.
+
+        Reference analog: vparquet's Fetch compiling traceql conditions
+        into a parquetquery iterator tree (block_traceql.go:92-617).
+        Here each condition lowers to a span-row mask over row-group
+        columns (strings resolved via the block dictionary first);
+        unsupported conditions are skipped in AND mode (superset is
+        safe — the engine re-evaluates exactly) and force fetch-all in
+        OR mode (skipping would drop true matches).
+        """
+        from tempo_tpu.model.trace import batch_to_traces
+
+        d = self.dictionary()
+        resolvers = []
+        fetch_all = not spec.conditions
+        impossible = False
+        for cond in spec.conditions:
+            r = _lower_condition(cond, d)
+            if r == "impossible":
+                if spec.all_conditions:
+                    impossible = True
+                    break
+                continue  # OR: this arm matches nothing; others may match
+            if r is None:  # unsupported op
+                if not spec.all_conditions:
+                    fetch_all = True  # OR with an opaque arm: can't prune
+                continue
+            resolvers.append(r)
+        if impossible:
+            return []
+        if not resolvers:
+            fetch_all = True
+
+        out = []
+        for rg in self.index().row_groups:
+            if start_s and rg.end_s < start_s:
+                continue
+            if end_s and rg.start_s > end_s:
+                continue
+            n = rg.n_spans
+            if fetch_all:
+                span_mask = np.ones(n, bool)
+            else:
+                masks = [r(self, rg) for r in resolvers]
+                span_mask = masks[0]
+                for m in masks[1:]:
+                    span_mask = (span_mask & m) if spec.all_conditions else (span_mask | m)
+            if not span_mask.any():
+                continue
+            tid = self.read_columns(rg, ["trace_id"])["trace_id"]
+            from tempo_tpu.model.columnar import hit_trace_mask, trace_segmentation
+
+            _, seg, _ = trace_segmentation(tid)
+            hit_traces = hit_trace_mask(seg, span_mask, int(seg[-1]) + 1)
+            rows = np.flatnonzero(hit_traces[seg])  # all spans of hit traces
+            out.extend(batch_to_traces(self._rows_to_batch(rg, rows)))
+            if max_traces and len(out) >= max_traces:
+                break
+        return out
+
+    def collect_spans_for_ids(self, hex_ids: set) -> list:
+        """All spans of the given trace IDs present in this block.
+
+        Completes partial traces when a trace straddles blocks and only
+        some blocks' spans matched the pushdown conditions — structural
+        operators (childCount, parent, >>) need whole traces
+        (traceql engine contract)."""
+        from tempo_tpu.model.trace import batch_to_traces
+
+        lo, hi = min(hex_ids), max(hex_ids)
+        if hi < self.meta.min_id or lo > self.meta.max_id:
+            return []
+        limbs = np.stack([fmt.hex_to_limbs(h) for h in hex_ids])
+        key_view = limbs.copy().view("V16").reshape(-1)
+        out = []
+        for rg in self.index().row_groups:
+            if rg.max_id < lo or rg.min_id > hi:
+                continue
+            tid = self.read_columns(rg, ["trace_id"])["trace_id"]
+            rows = np.flatnonzero(np.isin(tid.copy().view("V16").reshape(-1), key_view))
+            if len(rows):
+                out.extend(batch_to_traces(self._rows_to_batch(rg, rows)))
+        return out
+
+
+def _lower_condition(cond, d):
+    """Condition -> callable(block, rg) -> span mask, or None
+    (unsupported), or "impossible" (can never match this block)."""
+    op, val = cond.op, cond.value
+
+    def col_mask(col_name, codes):
+        def run(blk, rg):
+            c = blk.read_columns(rg, [col_name])[col_name]
+            return np.isin(c, codes)
+
+        return run
+
+    if cond.scope == "intrinsic":
+        if cond.name == "name" and op in ("=", "=~"):
+            codes = _string_codes(d, op, val)
+            if codes is None:
+                return "impossible"
+            return col_mask("name", codes)
+        if cond.name == "duration" and op in (">", ">=", "<", "<=", "="):
+            def run(blk, rg):
+                dur = blk.read_columns(rg, ["duration_nano"])["duration_nano"]
+                return {
+                    ">": dur > val,
+                    ">=": dur >= val,
+                    "<": dur < val,
+                    "<=": dur <= val,
+                    "=": dur == val,
+                }[op]
+
+            return run
+        if cond.name in ("status", "kind") and op == "=":
+            col = "status_code" if cond.name == "status" else "kind"
+
+            def run(blk, rg):
+                c = blk.read_columns(rg, [col])[col]
+                return c == val
+
+            return run
+        return None
+
+    if cond.scope in ("any", "span", "resource"):
+        if cond.name == "service.name" and op in ("=", "=~"):
+            codes = _string_codes(d, op, val)
+            if codes is None:
+                return "impossible"
+            return col_mask("service", codes)
+        if cond.name == "http.method" and op in ("=", "=~"):
+            codes = _string_codes(d, op, val)
+            if codes is None:
+                return "impossible"
+            return col_mask("http_method", codes)
+        if cond.name == "http.url" and op in ("=", "=~"):
+            codes = _string_codes(d, op, val)
+            if codes is None:
+                return "impossible"
+            return col_mask("http_url", codes)
+        if cond.name == "http.status_code" and op in ("=", ">", ">=", "<", "<="):
+            def run(blk, rg):
+                c = blk.read_columns(rg, ["http_status"])["http_status"]
+                return {
+                    "=": c == val,
+                    ">": c > val,
+                    ">=": c >= val,
+                    "<": c < val,
+                    "<=": c <= val,
+                }[op]
+
+            return run
+        return _lower_attr_condition(cond, d)
+
+    return None
+
+
+def _lower_attr_condition(cond, d):
+    from tempo_tpu.model.columnar import SCOPE_RESOURCE, SCOPE_SPAN, VT_BOOL, VT_FLOAT, VT_INT, VT_STR
+
+    op, val = cond.op, cond.value
+    kc = d.get(cond.name)
+    if kc is None:
+        return "impossible"
+
+    if isinstance(val, str):
+        if op not in ("=", "=~"):
+            return None
+        codes = _string_codes(d, op, val)
+        if codes is None:
+            return "impossible"
+        want_vt = VT_STR
+    elif isinstance(val, bool):
+        if op != "=":
+            return None
+        codes, want_vt = None, VT_BOOL
+    elif isinstance(val, (int, float)):
+        if op not in ("=", ">", ">=", "<", "<="):
+            return None
+        codes, want_vt = None, None  # numeric: INT or FLOAT
+    else:
+        return None
+
+    def run(blk, rg):
+        a = blk.read_columns(rg, ["attr_span", "attr_scope", "attr_key", "attr_vtype", "attr_str", "attr_num"])
+        rows = a["attr_key"] == np.uint32(kc)
+        if cond.scope == "span":
+            rows &= a["attr_scope"] == SCOPE_SPAN
+        elif cond.scope == "resource":
+            rows &= a["attr_scope"] == SCOPE_RESOURCE
+        if want_vt == VT_STR:
+            rows &= (a["attr_vtype"] == VT_STR) & np.isin(a["attr_str"], codes)
+        elif want_vt == VT_BOOL:
+            rows &= (a["attr_vtype"] == VT_BOOL) & ((a["attr_num"] != 0) == val)
+        else:
+            num = a["attr_num"]
+            rows &= np.isin(a["attr_vtype"], [VT_INT, VT_FLOAT]) & {
+                "=": num == val,
+                ">": num > val,
+                ">=": num >= val,
+                "<": num < val,
+                "<=": num <= val,
+            }[op]
+        mask = np.zeros(rg.n_spans, bool)
+        mask[a["attr_span"][rows]] = True
+        return mask
+
+    return run
+
+
+def _string_codes(d, op, val):
+    """Dictionary codes matching a string predicate, or None if nothing
+    can match in this block."""
+    import re as _re
+
+    if op == "=":
+        code = d.get(val)
+        return None if code is None else np.array([code], np.uint32)
+    rx = _re.compile(val)
+    codes = [i for i, e in enumerate(d.entries) if rx.search(e)]
+    return np.asarray(codes, np.uint32) if codes else None
 
 
 def _resolve_tag_predicates(req: SearchRequest, d):

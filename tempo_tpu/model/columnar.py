@@ -110,6 +110,30 @@ class Dictionary:
         return table
 
 
+def trace_segmentation(tid: np.ndarray):
+    """For trace-sorted ID rows (N,4): (new_mask, seg_ids, firsts).
+
+    The shared idiom behind every span->trace rollup (search, fetch,
+    live scan): new_mask flags the first row of each trace, seg_ids maps
+    span row -> 0-based trace index, firsts lists first-row indices.
+    """
+    n = tid.shape[0]
+    if n == 0:
+        return np.empty(0, bool), np.empty(0, np.int64), np.empty(0, np.int64)
+    new = np.ones(n, dtype=bool)
+    new[1:] = (tid[1:] != tid[:-1]).any(axis=1)
+    seg = np.cumsum(new) - 1
+    return new, seg, np.flatnonzero(new)
+
+
+def hit_trace_mask(seg: np.ndarray, span_mask: np.ndarray, n_traces: int) -> np.ndarray:
+    """Trace-level any-span-matched rollup (numpy twin of
+    ops.scan.spans_to_traces_any)."""
+    hit = np.zeros(n_traces, bool)
+    np.logical_or.at(hit, seg[span_mask], True)
+    return hit
+
+
 def _empty_cols(schema: dict) -> dict[str, np.ndarray]:
     out = {}
     for name, (dtype, width) in schema.items():

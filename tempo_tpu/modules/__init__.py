@@ -1,0 +1,8 @@
+"""Service modules — the roles of the distributed system.
+
+Reference: modules/{distributor,ingester,querier,frontend,compactor,
+generator,overrides} (SURVEY.md sections 2.2-2.3). Each module is a
+plain object with explicit lifecycle methods; the app wiring
+(tempo_tpu.app) composes them single-binary style or per-role, with the
+ring deciding data placement exactly like the reference's dskit ring.
+"""

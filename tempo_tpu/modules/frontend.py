@@ -1,0 +1,186 @@
+"""Query frontend — shards queries into jobs, queues them, merges results.
+
+Reference: modules/frontend (trace-by-ID sharder splitting the uuid
+space uniformly tracebyidsharding.go:51-228, search sharder emitting one
+job per chunk of block data searchsharding.go:69-314, retry retry.go,
+hedging, span deduping deduper.go) over the fair queue
+(modules/frontend/v1 + pkg/scheduler/queue).
+
+In-process form: sharders emit job callables into the RequestQueue;
+worker threads (the "queriers") execute them; the frontend waits on a
+completion latch and merges. The process boundary (httpgrpc in the
+reference) maps to the queue seam, so a networked deployment only swaps
+the queue transport.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+from tempo_tpu.encoding.common import SearchRequest, SearchResponse
+from tempo_tpu.model.trace import combine_traces
+
+log = logging.getLogger(__name__)
+
+
+def _client_error(e: Exception) -> bool:
+    """4xx-equivalents must not burn retries (reference retry.go:15
+    retries server errors only)."""
+    from tempo_tpu.traceql import ParseError
+
+    return isinstance(e, (ParseError, ValueError, PermissionError))
+
+
+def create_block_boundaries(n_shards: int) -> list[str]:
+    """n_shards+1 uniform 128-bit hex boundaries (reference:
+    tracebyidsharding.go:228 createBlockBoundaries)."""
+    if n_shards <= 0:
+        return ["0" * 32, "f" * 32]
+    space = 1 << 128
+    bounds = [format((space * i) // n_shards, "032x") for i in range(n_shards)]
+    bounds.append("f" * 32)
+    return bounds
+
+
+@dataclass
+class FrontendConfig:
+    query_shards: int = 4
+    max_retries: int = 2
+    # search: one backend job per this many bytes of block data
+    target_bytes_per_job: int = 100 * 1024 * 1024
+    query_ingesters_until_s: int = 3600  # recent window served by ingesters
+    max_duration_s: int = 0  # per-tenant via overrides wins
+
+
+class _Latch:
+    def __init__(self, n: int):
+        self.n = n
+        self.results = []
+        self.errors = []
+        self.cv = threading.Condition()
+
+    def done(self, result=None, error=None):
+        with self.cv:
+            if error is not None:
+                self.errors.append(error)
+            elif result is not None:
+                self.results.append(result)
+            self.n -= 1
+            if self.n <= 0:
+                self.cv.notify_all()
+
+    def wait(self, timeout=60.0):
+        with self.cv:
+            if not self.cv.wait_for(lambda: self.n <= 0, timeout=timeout):
+                raise TimeoutError("query jobs timed out")
+        return self.results, self.errors
+
+
+class Frontend:
+    def __init__(self, queue, querier, cfg: FrontendConfig | None = None, overrides=None):
+        self.queue = queue
+        self.querier = querier
+        self.cfg = cfg or FrontendConfig()
+        self.overrides = overrides
+
+    # ------------------------------------------------------------------
+    def _run_jobs(self, tenant: str, fns) -> tuple[list, list]:
+        latch = _Latch(len(fns))
+
+        def wrap(fn):
+            def job():
+                for attempt in range(self.cfg.max_retries + 1):
+                    try:
+                        latch.done(result=fn())
+                        return
+                    except Exception as e:  # retry ware (reference retry.go: 5xx only)
+                        if attempt >= self.cfg.max_retries or _client_error(e):
+                            latch.done(error=e)
+                            return
+                        log.warning("job retry %d after: %s", attempt + 1, e)
+
+            return job
+
+        for fn in fns:
+            self.queue.enqueue(tenant, wrap(fn))
+        return latch.wait()
+
+    # ------------------------------------------------------------------
+    def find_trace_by_id(self, tenant: str, trace_id: bytes):
+        """Shard the blockID space + one ingester job; combine partials,
+        dedupe spans (reference: newTraceByIDMiddleware frontend.go:97)."""
+        bounds = create_block_boundaries(self.cfg.query_shards)
+        jobs = [
+            lambda: self.querier.find_trace_by_id(tenant, trace_id, mode="ingesters")
+        ]
+        for i in range(len(bounds) - 1):
+            lo, hi = bounds[i], bounds[i + 1]
+            jobs.append(
+                lambda lo=lo, hi=hi: self.querier.find_trace_by_id(
+                    tenant, trace_id, mode="blocks", block_start=lo, block_end=hi
+                )
+            )
+        results, errors = self._run_jobs(tenant, jobs)
+        if errors and not results:
+            raise errors[0]
+        return combine_traces([r for r in results if r is not None])
+
+    # ------------------------------------------------------------------
+    def search(self, tenant: str, req: SearchRequest) -> SearchResponse:
+        """Ingester window job + one job per chunk of backend blocks
+        (reference: searchsharding.go:266 backendRequests)."""
+        if self.overrides is not None:
+            max_dur = self.overrides.for_tenant(tenant).max_search_duration_s
+            if max_dur and req.start_seconds and req.end_seconds:
+                if req.end_seconds - req.start_seconds > max_dur:
+                    raise ValueError(f"search window exceeds max_search_duration ({max_dur}s)")
+
+        now = time.time()
+        jobs = []
+        ing_cutoff = now - self.cfg.query_ingesters_until_s
+        if not req.end_seconds or req.end_seconds >= ing_cutoff:
+            jobs.append(lambda: self.querier.search_recent(tenant, req))
+
+        metas = [
+            m for m in self.querier.db.blocklist.metas(tenant)
+            if (not req.start_seconds or m.end_time >= req.start_seconds)
+            and (not req.end_seconds or m.start_time <= req.end_seconds)
+        ]
+        group, size = [], 0
+        for m in metas:
+            group.append(m)
+            size += max(m.size_bytes, 1)
+            if size >= self.cfg.target_bytes_per_job:
+                jobs.append(self._block_group_job(tenant, group, req))
+                group, size = [], 0
+        if group:
+            jobs.append(self._block_group_job(tenant, group, req))
+
+        results, errors = self._run_jobs(tenant, jobs)
+        if errors and not results:
+            raise errors[0]
+        out = SearchResponse()
+        for r in results:
+            out.merge(r, limit=req.limit)
+        return out
+
+    def _block_group_job(self, tenant, group, req):
+        def job():
+            resp = SearchResponse()
+            for m in group:
+                resp.merge(self.querier.search_block_job(tenant, m.block_id, req), limit=req.limit)
+            return resp
+
+        return job
+
+    # ------------------------------------------------------------------
+    def traceql(self, tenant: str, query: str, start_s=0, end_s=0, limit=20):
+        results, errors = self._run_jobs(
+            tenant, [lambda: self.querier.traceql(tenant, query, start_s, end_s, limit)]
+        )
+        if errors and not results:
+            raise errors[0]
+        return results[0] if results else []

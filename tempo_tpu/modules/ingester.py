@@ -1,0 +1,298 @@
+"""Ingester — live traces -> WAL head block -> complete block -> flush.
+
+Reference: modules/ingester (instance.go:98 per-tenant instance with
+mutex-guarded live-trace map, CutCompleteTraces:240, CutBlockIfReady:275,
+CompleteBlock:308, flush queues flush.go:124-360, WAL replay
+ingester.go:328, Limiter limiter.go:22).
+
+Array-first twist: a live trace is a list of columnar segments (what the
+distributor sent), so cutting traces to the WAL is batch concatenation,
+and completing a block is the engine's sorted-batch write — object trees
+never appear on the write path.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from tempo_tpu.encoding.vtpu import format as fmt
+from tempo_tpu.model.columnar import SpanBatch
+from tempo_tpu.model.trace import Trace, batch_to_traces, combine_traces
+
+log = logging.getLogger(__name__)
+
+
+class TraceTooLarge(Exception):
+    """Reference: instance.go:39-57 trace-too-large at push."""
+
+
+class MaxLiveTraces(Exception):
+    """Reference: limiter.AssertMaxTracesPerUser."""
+
+
+@dataclass
+class LiveTrace:
+    segments: list = field(default_factory=list)  # list[SpanBatch]
+    last_touch: float = 0.0
+    first_touch: float = 0.0
+    span_count: int = 0
+    byte_count: int = 0
+
+
+@dataclass
+class IngesterConfig:
+    max_trace_idle_s: float = 10.0
+    max_block_duration_s: float = 1800.0
+    max_block_bytes: int = 500 * 1024 * 1024
+    complete_block_timeout_s: float = 900.0  # keep flushed blocks queryable
+    flush_check_period_s: float = 10.0
+
+
+class TenantInstance:
+    def __init__(self, tenant: str, db, overrides, cfg: IngesterConfig):
+        self.tenant = tenant
+        self.db = db
+        self.overrides = overrides
+        self.cfg = cfg
+        self.lock = threading.Lock()
+        self.live: dict[bytes, LiveTrace] = {}
+        self.head = db.wal.new_block(tenant)
+        self.head_created = time.time()
+        self.completing: list = []  # wal blocks cut from head
+        self.flushed: list = []  # (meta, flushed_at) — cleared after timeout
+        self.traces_created = 0
+        self.spans_dropped_too_large = 0
+
+    # -- push -----------------------------------------------------------
+    def push_segment(self, data: bytes, now: float | None = None) -> None:
+        self.push_batch(fmt.deserialize_batch(data), now=now)
+
+    def push_batch(self, batch: SpanBatch, now: float | None = None) -> None:
+        """Per-trace errors don't abort the rest of the segment: valid
+        traces ingest exactly once, failures are aggregated and raised at
+        the end (reference: the distributor's multierror per-trace push
+        results). A retried segment may duplicate already-applied traces;
+        duplicates collapse at query combine and compaction dedupe."""
+        now = now or time.time()
+        lim = self.overrides.for_tenant(self.tenant)
+        tid = batch.cols["trace_id"]
+        uniq, inverse = np.unique(tid, axis=0, return_inverse=True)
+        errors: list[Exception] = []
+        with self.lock:
+            for u in range(len(uniq)):
+                rows = np.flatnonzero(inverse == u)
+                key = uniq[u].astype(">u4").tobytes()
+                lt = self.live.get(key)
+                if lt is None:
+                    if lim.max_traces_per_user and len(self.live) >= lim.max_traces_per_user:
+                        errors.append(
+                            MaxLiveTraces(
+                                f"tenant {self.tenant}: max live traces ({lim.max_traces_per_user})"
+                            )
+                        )
+                        continue
+                    lt = LiveTrace(first_touch=now)
+                    self.live[key] = lt
+                    self.traces_created += 1
+                sub = batch.select(rows)
+                if lim.max_spans_per_trace and lt.span_count + sub.num_spans > lim.max_spans_per_trace:
+                    self.spans_dropped_too_large += sub.num_spans
+                    errors.append(
+                        TraceTooLarge(f"trace {key.hex()} exceeds {lim.max_spans_per_trace} spans")
+                    )
+                    continue
+                if lim.max_bytes_per_trace and lt.byte_count + sub.nbytes() > lim.max_bytes_per_trace:
+                    self.spans_dropped_too_large += sub.num_spans
+                    errors.append(TraceTooLarge(f"trace {key.hex()} exceeds byte limit"))
+                    continue
+                lt.segments.append(sub)
+                lt.span_count += sub.num_spans
+                lt.byte_count += sub.nbytes()
+                lt.last_touch = now
+        if errors:
+            raise errors[0]
+
+    # -- cuts -----------------------------------------------------------
+    def cut_complete_traces(self, now: float | None = None, immediate: bool = False) -> int:
+        """Idle traces -> head WAL block (reference: instance.go:240)."""
+        now = now or time.time()
+        cut = []
+        with self.lock:
+            for key, lt in list(self.live.items()):
+                if immediate or now - lt.last_touch > self.cfg.max_trace_idle_s:
+                    cut.append((key, lt))
+                    del self.live[key]
+        if not cut:
+            return 0
+        batch = SpanBatch.concat([seg for _, lt in cut for seg in lt.segments]).sorted_by_trace()
+        self.head.append(batch)
+        return len(cut)
+
+    def cut_block_if_ready(self, now: float | None = None, immediate: bool = False):
+        """Head block -> completing (reference: instance.go:275)."""
+        now = now or time.time()
+        with self.lock:
+            ready = self.head.num_segments() > 0 and (
+                immediate
+                or now - self.head_created > self.cfg.max_block_duration_s
+                or self.head.size_bytes() > self.cfg.max_block_bytes
+            )
+            if not ready:
+                return None
+            blk = self.head
+            self.completing.append(blk)
+            self.head = self.db.wal.new_block(self.tenant)
+            self.head_created = now
+            return blk
+
+    def complete_and_flush(self, now: float | None = None) -> list:
+        """Completing WAL blocks -> backend blocks; WAL dirs removed
+        after a successful write (reference: CompleteBlock:308 +
+        handleFlush flush.go:297; single-step here because the write
+        already lands in the object store)."""
+        now = now or time.time()
+        out = []
+        with self.lock:
+            todo = list(self.completing)
+        for blk in todo:
+            try:
+                meta = self.db.write_wal_block(self.tenant, blk, block_id=blk.block_id)
+                # block stays in `completing` (queryable) until the backend
+                # write has succeeded and the blocklist knows about it —
+                # only then does the WAL copy disappear, so there is no
+                # window where the data is visible nowhere
+                with self.lock:
+                    if blk in self.completing:
+                        self.completing.remove(blk)
+                    if meta is not None:
+                        self.flushed.append((meta, now))
+                if meta is not None:
+                    out.append(meta)
+                blk.clear()
+            except Exception:
+                log.exception("complete/flush failed for %s; will retry", blk.block_id)
+        return out
+
+    def clear_flushed_blocks(self, now: float | None = None) -> int:
+        now = now or time.time()
+        with self.lock:
+            before = len(self.flushed)
+            self.flushed = [
+                (m, at) for m, at in self.flushed if now - at < self.cfg.complete_block_timeout_s
+            ]
+            return before - len(self.flushed)
+
+    # -- queries over not-yet-backend state ------------------------------
+    def find_trace_by_id(self, trace_id: bytes) -> Trace | None:
+        key = trace_id.rjust(16, b"\x00")[-16:]
+        parts = []
+        with self.lock:
+            lt = self.live.get(key)
+            segments = list(lt.segments) if lt else []
+        if segments:
+            parts.extend(batch_to_traces(SpanBatch.concat(segments)))
+        limbs = np.frombuffer(key, dtype=">u4").astype(np.uint32)
+        with self.lock:
+            wal_blocks = [self.head] + list(self.completing)
+        for blk in wal_blocks:
+            for seg in blk.iter_batches():
+                rows = np.flatnonzero((seg.cols["trace_id"] == limbs[None, :]).all(axis=1))
+                if len(rows):
+                    parts.extend(batch_to_traces(seg.select(rows)))
+        return combine_traces(parts)
+
+    def live_batches(self) -> list[SpanBatch]:
+        """All not-yet-flushed columnar data (for SearchRecent)."""
+        with self.lock:
+            segs = [seg for lt in self.live.values() for seg in lt.segments]
+            wal_blocks = [self.head] + list(self.completing)
+        for blk in wal_blocks:
+            segs.extend(blk.iter_batches())
+        return segs
+
+
+class Ingester:
+    def __init__(self, db, overrides, cfg: IngesterConfig | None = None,
+                 instance_id: str = "ingester-0"):
+        self.db = db
+        self.overrides = overrides
+        self.cfg = cfg or IngesterConfig()
+        self.instance_id = instance_id
+        self.instances: dict[str, TenantInstance] = {}
+        self.lock = threading.Lock()
+        self._stop = threading.Event()
+        self._loop_thread = None
+        self.replay()
+
+    def instance(self, tenant: str) -> TenantInstance:
+        with self.lock:
+            inst = self.instances.get(tenant)
+            if inst is None:
+                inst = TenantInstance(tenant, self.db, self.overrides, self.cfg)
+                self.instances[tenant] = inst
+            return inst
+
+    # -- rpc surface -----------------------------------------------------
+    def push_segment(self, tenant: str, data: bytes) -> None:
+        self.instance(tenant).push_segment(data)
+
+    def find_trace_by_id(self, tenant: str, trace_id: bytes) -> Trace | None:
+        with self.lock:
+            inst = self.instances.get(tenant)
+        return inst.find_trace_by_id(trace_id) if inst else None
+
+    def live_batches(self, tenant: str) -> list[SpanBatch]:
+        with self.lock:
+            inst = self.instances.get(tenant)
+        return inst.live_batches() if inst else []
+
+    # -- lifecycle -------------------------------------------------------
+    def replay(self) -> None:
+        """Reattach WAL blocks found on disk as completing blocks
+        (reference: replayWal ingester.go:328)."""
+        for blk in self.db.wal.rescan_blocks():
+            inst = self.instance(blk.tenant)
+            with inst.lock:
+                inst.completing.append(blk)
+            log.info("replayed wal block %s for tenant %s", blk.block_id, blk.tenant)
+
+    def sweep(self, immediate: bool = False) -> None:
+        """One maintenance pass over all instances (reference:
+        sweepAllInstances flush.go:144)."""
+        with self.lock:
+            instances = list(self.instances.values())
+        for inst in instances:
+            inst.cut_complete_traces(immediate=immediate)
+            inst.cut_block_if_ready(immediate=immediate)
+            inst.complete_and_flush()
+            inst.clear_flushed_blocks()
+
+    def flush_all(self) -> None:
+        """Graceful-shutdown drain (reference: /shutdown flush.go:91)."""
+        self.sweep(immediate=True)
+
+    def start_loop(self) -> None:
+        if self._loop_thread:
+            return
+
+        def loop():
+            while not self._stop.wait(self.cfg.flush_check_period_s):
+                try:
+                    self.sweep()
+                except Exception:
+                    log.exception("ingester sweep failed")
+
+        self._loop_thread = threading.Thread(target=loop, daemon=True, name="ingester-sweep")
+        self._loop_thread.start()
+
+    def stop(self, flush: bool = True) -> None:
+        self._stop.set()
+        if self._loop_thread:
+            self._loop_thread.join(timeout=5)
+        if flush:
+            self.flush_all()

@@ -1,0 +1,195 @@
+"""Querier — executes queries against ingesters (recent) + backend blocks.
+
+Reference: modules/querier/querier.go (FindTraceByID:186 fanning to the
+ring replication set then the store, SearchRecent:326, SearchBlock:432,
+TraceQL delegation :469).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+import numpy as np
+
+from tempo_tpu.encoding.common import SearchRequest, SearchResponse, TraceSearchMetadata
+from tempo_tpu.encoding.vtpu import format as fmt
+from tempo_tpu.model.columnar import SpanBatch
+from tempo_tpu.model.trace import combine_traces
+from tempo_tpu.ops import hashing
+
+log = logging.getLogger(__name__)
+
+
+class Querier:
+    def __init__(self, db, ring=None, ingester_clients: dict | None = None):
+        """ingester_clients: instance_id -> object with
+        find_trace_by_id(tenant, tid) and live_batches(tenant)."""
+        self.db = db
+        self.ring = ring
+        self.ingester_clients = ingester_clients or {}
+
+    # ------------------------------------------------------------------
+    def _replica_clients(self, tenant: str, trace_id: bytes):
+        if not self.ring or not self.ingester_clients:
+            return list(self.ingester_clients.values())
+        token = hashing.token_for(tenant, trace_id)
+        reps = self.ring.get_replicas(token)
+        return [self.ingester_clients[r.instance_id] for r in reps if r.instance_id in self.ingester_clients]
+
+    def find_trace_by_id(self, tenant: str, trace_id: bytes, mode: str = "all",
+                         block_start: str = "0" * 32, block_end: str = "f" * 32):
+        """mode: ingesters | blocks | all (reference: querier.go:186 +
+        the frontend's mode param, pkg/api)."""
+        parts = []
+        if mode in ("ingesters", "all"):
+            for client in self._replica_clients(tenant, trace_id):
+                try:
+                    t = client.find_trace_by_id(tenant, trace_id)
+                    if t is not None:
+                        parts.append(t)
+                except Exception:
+                    log.exception("ingester find failed")
+        if mode in ("blocks", "all"):
+            t = self.db.find(tenant, trace_id, block_start=block_start, block_end=block_end)
+            if t is not None:
+                parts.append(t)
+        return combine_traces(parts)
+
+    # ------------------------------------------------------------------
+    def search_recent(self, tenant: str, req: SearchRequest) -> SearchResponse:
+        """Search not-yet-flushed data on all ingesters (reference:
+        SearchRecent:326; ours scans live columnar segments)."""
+        resp = SearchResponse()
+        for client in self.ingester_clients.values():
+            try:
+                batches = client.live_batches(tenant)
+            except Exception:
+                log.exception("ingester live_batches failed")
+                continue
+            for batch in batches:
+                resp.merge(_search_batch(batch, req), limit=req.limit)
+        return resp
+
+    def search_blocks(self, tenant: str, req: SearchRequest) -> SearchResponse:
+        return self.db.search(tenant, req)
+
+    def search(self, tenant: str, req: SearchRequest) -> SearchResponse:
+        out = self.search_recent(tenant, req)
+        out.merge(self.search_blocks(tenant, req), limit=req.limit)
+        return out
+
+    def search_block_job(self, tenant: str, block_id: str, req: SearchRequest) -> SearchResponse:
+        return self.db.search_block(tenant, block_id, req)
+
+    def traceql(self, tenant: str, query: str, start_s=0, end_s=0, limit=20):
+        results = self.db.traceql_search(tenant, query, start_s, end_s, limit)
+        # include candidates from live ingester data
+        from tempo_tpu.traceql import execute
+
+        live_traces = []
+        for client in self.ingester_clients.values():
+            try:
+                for batch in client.live_batches(tenant):
+                    from tempo_tpu.model.trace import batch_to_traces
+
+                    live_traces.extend(batch_to_traces(batch))
+            except Exception:
+                log.exception("ingester live_batches failed")
+        if live_traces:
+            by_id = {}
+            for t in live_traces:
+                by_id.setdefault(t.trace_id, []).append(t)
+            combined = [combine_traces(v) for v in by_id.values()]
+            results.extend(execute(query, lambda spec, s, e: combined, start_s=start_s, end_s=end_s, limit=limit))
+            seen = set()
+            uniq = []
+            for r in sorted(results, key=lambda r: -r.start_time_unix_nano):
+                if r.trace_id_hex not in seen:
+                    seen.add(r.trace_id_hex)
+                    uniq.append(r)
+            results = uniq[:limit] if limit else uniq
+        return results
+
+
+def _search_batch(batch: SpanBatch, req: SearchRequest) -> SearchResponse:
+    """Tag search over one in-memory columnar segment (numpy path —
+    live segments are small)."""
+    resp = SearchResponse()
+    n = batch.num_spans
+    if n == 0:
+        return resp
+    d = batch.dictionary
+    mask = np.ones(n, bool)
+    for k, v in req.tags.items():
+        v = str(v)
+        if k in ("name",):
+            code = d.get(v)
+            mask &= (batch.cols["name"] == code) if code is not None else False
+        elif k in ("service.name", "service"):
+            code = d.get(v)
+            mask &= (batch.cols["service"] == code) if code is not None else False
+        elif k == "http.status_code":
+            try:
+                mask &= batch.cols["http_status"] == int(v)
+            except ValueError:
+                return resp
+        elif k == "http.method":
+            code = d.get(v)
+            mask &= (batch.cols["http_method"] == code) if code is not None else False
+        elif k == "http.url":
+            code = d.get(v)
+            mask &= (batch.cols["http_url"] == code) if code is not None else False
+        else:
+            kc, vc = d.get(k), d.get(v)
+            if kc is None or vc is None:
+                return resp
+            from tempo_tpu.model.columnar import VT_STR
+
+            a = batch.attrs
+            rows = (a["attr_key"] == kc) & (a["attr_vtype"] == VT_STR) & (a["attr_str"] == vc)
+            ok = np.zeros(n, bool)
+            ok[a["attr_span"][rows]] = True
+            mask &= ok
+    if req.min_duration_ns:
+        mask &= batch.cols["duration_nano"] >= np.uint64(req.min_duration_ns)
+    if req.max_duration_ns:
+        mask &= batch.cols["duration_nano"] <= np.uint64(req.max_duration_ns)
+    if not mask.any():
+        return resp
+
+    sb = batch.sorted_by_trace()
+    # recompute mask on sorted batch via trace+span identity is overkill;
+    # instead sort the mask with the same permutation the sort used
+    keys = np.concatenate([batch.cols["trace_id"], batch.cols["span_id"]], axis=1)
+    perm = np.lexsort(tuple(keys[:, i] for i in reversed(range(6))))
+    smask = mask[perm]
+    from tempo_tpu.model.columnar import hit_trace_mask, trace_segmentation
+
+    tid = sb.cols["trace_id"]
+    _, seg, firsts = trace_segmentation(tid)
+    hit = hit_trace_mask(seg, smask, int(seg[-1]) + 1)
+    starts = sb.cols["start_unix_nano"]
+    ends = starts + sb.cols["duration_nano"]
+    for t in np.flatnonzero(hit):
+        lo = firsts[t]
+        hi = firsts[t + 1] if t + 1 < len(firsts) else sb.num_spans
+        rows = np.arange(lo, hi)
+        roots = rows[(sb.cols["parent_span_id"][rows] == 0).all(axis=1)]
+        root = roots[0] if len(roots) else lo
+        t_start, t_end = int(starts[rows].min()), int(ends[rows].max())
+        if req.start_seconds and t_end < req.start_seconds * 10**9:
+            continue
+        if req.end_seconds and t_start > req.end_seconds * 10**9:
+            continue
+        resp.traces.append(
+            TraceSearchMetadata(
+                trace_id_hex=fmt.id_to_hex(tid[lo]),
+                root_service_name=d[int(sb.cols["service"][root])],
+                root_trace_name=d[int(sb.cols["name"][root])],
+                start_time_unix_nano=t_start,
+                duration_ms=(t_end - t_start) // 10**6,
+            )
+        )
+    resp.inspected_traces = int(seg[-1]) + 1
+    return resp

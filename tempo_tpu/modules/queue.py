@@ -1,0 +1,109 @@
+"""Fair per-tenant request queue with pull workers.
+
+Reference: pkg/scheduler/queue (RequestQueue queue.go:49, per-tenant
+round-robin user_queues.go:25, querier shuffle-shard assignment,
+frontend v1 Process pull loop). Queriers pull jobs; tenants are served
+round-robin so one heavy tenant can't starve others; per-tenant depth
+caps produce backpressure ("too many outstanding requests").
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+
+class TooManyRequests(Exception):
+    """Reference: frontend v1's 'too many outstanding requests'."""
+
+
+class QueueStopped(Exception):
+    pass
+
+
+class RequestQueue:
+    def __init__(self, max_per_tenant: int = 2000):
+        self.max_per_tenant = max_per_tenant
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queues: dict[str, deque] = {}
+        self._rr: list[str] = []  # round-robin order of tenants
+        self._rr_idx = 0
+        self._stopped = False
+        self.enqueued = 0
+        self.discarded = 0
+
+    def enqueue(self, tenant: str, job) -> None:
+        with self._cv:
+            if self._stopped:
+                raise QueueStopped()
+            q = self._queues.get(tenant)
+            if q is None:
+                q = deque()
+                self._queues[tenant] = q
+                self._rr.append(tenant)
+            if len(q) >= self.max_per_tenant:
+                self.discarded += 1
+                raise TooManyRequests(f"tenant {tenant}: queue full")
+            q.append(job)
+            self.enqueued += 1
+            self._cv.notify()
+
+    def dequeue(self, timeout: float | None = None):
+        """Next job, fair across tenants -> (tenant, job) or None on
+        timeout/stop."""
+        with self._cv:
+            while True:
+                if self._stopped:
+                    return None
+                for _ in range(len(self._rr)):
+                    tenant = self._rr[self._rr_idx % len(self._rr)]
+                    self._rr_idx += 1
+                    q = self._queues.get(tenant)
+                    if q:
+                        return tenant, q.popleft()
+                if not self._cv.wait(timeout=timeout):
+                    return None
+
+    def lengths(self) -> dict[str, int]:
+        with self._lock:
+            return {t: len(q) for t, q in self._queues.items() if q}
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+
+
+class WorkerPool:
+    """Pull workers executing queue jobs (the querier worker half,
+    reference: modules/querier/worker)."""
+
+    def __init__(self, queue: RequestQueue, n_workers: int = 4):
+        self.queue = queue
+        self.threads = [
+            threading.Thread(target=self._run, daemon=True, name=f"query-worker-{i}")
+            for i in range(n_workers)
+        ]
+        for t in self.threads:
+            t.start()
+
+    def _run(self):
+        while True:
+            item = self.queue.dequeue(timeout=0.5)
+            if item is None:
+                if self.queue._stopped:
+                    return
+                continue
+            _, job = item
+            try:
+                job()
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception("query job failed")
+
+    def stop(self):
+        self.queue.stop()
+        for t in self.threads:
+            t.join(timeout=2)

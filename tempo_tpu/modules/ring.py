@@ -1,0 +1,231 @@
+"""Consistent-hash ring + membership.
+
+Reference: vendored dskit ring (SURVEY.md section 2.8 P1) — instances
+own random tokens on a uint32 ring; a trace's token (hash of tenant +
+trace ID) walks clockwise to find its replication set; heartbeats gate
+health. The reference gossips ring state via memberlist; here the
+KV store is pluggable: in-memory for single-binary / tests, a
+file-backed store for multi-process on one host (the e2e pattern), and
+any networked KV can implement the same 3-method interface.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+ACTIVE = "ACTIVE"
+LEAVING = "LEAVING"
+UNHEALTHY = "UNHEALTHY"
+
+
+@dataclass
+class InstanceDesc:
+    instance_id: str
+    addr: str = ""
+    tokens: list = field(default_factory=list)
+    state: str = ACTIVE
+    heartbeat: float = 0.0
+
+    def healthy(self, timeout_s: float, now: float) -> bool:
+        return self.state == ACTIVE and (timeout_s <= 0 or now - self.heartbeat <= timeout_s)
+
+
+class KVStore:
+    """Ring state store: get/cas semantics like dskit kv."""
+
+    def get(self) -> dict:
+        raise NotImplementedError
+
+    def update(self, mutate) -> dict:
+        """Atomically apply mutate(dict) -> dict and persist."""
+        raise NotImplementedError
+
+
+class MemoryKV(KVStore):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data: dict = {}
+
+    def get(self):
+        with self._lock:
+            return json.loads(json.dumps(self._data)) if self._data else {}
+
+    def update(self, mutate):
+        with self._lock:
+            self._data = mutate(json.loads(json.dumps(self._data)) if self._data else {})
+            return self._data
+
+
+class FileKV(KVStore):
+    """Shared-file ring state for multi-process single-host clusters
+    (the reference's e2e topology without docker)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def get(self):
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return {}
+
+    def update(self, mutate):
+        with self._lock:
+            cur = self.get()
+            new = mutate(cur)
+            tmp = f"{self.path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(new, f)
+            os.replace(tmp, self.path)
+            return new
+
+
+NUM_TOKENS = 128
+
+
+class Ring:
+    def __init__(self, kv: KVStore, heartbeat_timeout_s: float = 60.0,
+                 replication_factor: int = 1):
+        self.kv = kv
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.replication_factor = replication_factor
+
+    # -- membership (Lifecycler role) -----------------------------------
+    def register(self, instance_id: str, addr: str = "", n_tokens: int = NUM_TOKENS,
+                 seed: int | None = None) -> None:
+        rng = random.Random(seed if seed is not None else instance_id)
+        tokens = sorted(rng.randrange(0, 2**32) for _ in range(n_tokens))
+
+        def mutate(state):
+            state[instance_id] = {
+                "addr": addr,
+                "tokens": tokens,
+                "state": ACTIVE,
+                "heartbeat": time.time(),
+            }
+            return state
+
+        self.kv.update(mutate)
+
+    def heartbeat(self, instance_id: str) -> None:
+        def mutate(state):
+            if instance_id in state:
+                state[instance_id]["heartbeat"] = time.time()
+            return state
+
+        self.kv.update(mutate)
+
+    def set_state(self, instance_id: str, st: str) -> None:
+        def mutate(state):
+            if instance_id in state:
+                state[instance_id]["state"] = st
+            return state
+
+        self.kv.update(mutate)
+
+    def unregister(self, instance_id: str) -> None:
+        def mutate(state):
+            state.pop(instance_id, None)
+            return state
+
+        self.kv.update(mutate)
+
+    # -- reads ----------------------------------------------------------
+    def instances(self) -> list[InstanceDesc]:
+        now = time.time()
+        out = []
+        for iid, d in self.kv.get().items():
+            out.append(
+                InstanceDesc(
+                    instance_id=iid,
+                    addr=d.get("addr", ""),
+                    tokens=d.get("tokens", []),
+                    state=d.get("state", ACTIVE),
+                    heartbeat=d.get("heartbeat", 0.0),
+                )
+            )
+        return out
+
+    def healthy_instances(self) -> list[InstanceDesc]:
+        now = time.time()
+        return [i for i in self.instances() if i.healthy(self.heartbeat_timeout_s, now)]
+
+    def snapshot(self) -> "RingSnapshot":
+        """One consistent view for a batch of lookups — the hot ingest
+        path takes one snapshot per push instead of re-reading and
+        re-sorting the ring per trace."""
+        return RingSnapshot(self.healthy_instances(), self.replication_factor)
+
+    def get_replicas(self, token: int) -> list[InstanceDesc]:
+        """Replication set for a token: walk clockwise collecting RF
+        distinct healthy instances (reference: ring.Get with Write op)."""
+        return self.snapshot().get_replicas(token)
+
+    def start_heartbeat(self, instance_id: str, period_s: float = 10.0) -> threading.Event:
+        """Background heartbeat for a registered instance; returns the
+        stop event. Without this, the instance ages out of the healthy
+        set after heartbeat_timeout_s (reference: dskit Lifecycler's
+        heartbeat loop)."""
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(period_s):
+                try:
+                    self.heartbeat(instance_id)
+                except Exception:
+                    pass
+
+        threading.Thread(target=loop, daemon=True, name=f"heartbeat-{instance_id}").start()
+        return stop
+
+    def shuffle_shard(self, key: str, size: int) -> list[InstanceDesc]:
+        """Deterministic per-tenant subset (reference: generator shuffle-
+        sharding, modules/distributor/distributor.go:447)."""
+        healthy = sorted(self.healthy_instances(), key=lambda i: i.instance_id)
+        if size <= 0 or size >= len(healthy):
+            return healthy
+        rng = random.Random(key)
+        return sorted(rng.sample(healthy, size), key=lambda i: i.instance_id)
+
+    def owns(self, instance_id: str, job_hash: int) -> bool:
+        """Work-sharding ownership: does instance own this job token?
+        (reference: modules/compactor/compactor.go:189-217)."""
+        replicas = self.get_replicas(job_hash % (2**32))
+        return bool(replicas) and replicas[0].instance_id == instance_id
+
+
+class RingSnapshot:
+    """Immutable sorted token ring for repeated lookups."""
+
+    def __init__(self, instances: list[InstanceDesc], replication_factor: int):
+        self.replication_factor = replication_factor
+        self._instances = {i.instance_id: i for i in instances}
+        points = []
+        for inst in instances:
+            for t in inst.tokens:
+                points.append((t, inst.instance_id))
+        points.sort()
+        self._points = points
+        self._tokens = [t for t, _ in points]
+
+    def get_replicas(self, token: int) -> list[InstanceDesc]:
+        if not self._points:
+            return []
+        out, seen = [], set()
+        idx = bisect.bisect_right(self._tokens, token) % len(self._points)
+        for step in range(len(self._points)):
+            _, iid = self._points[(idx + step) % len(self._points)]
+            if iid not in seen:
+                seen.add(iid)
+                out.append(self._instances[iid])
+                if len(out) >= self.replication_factor:
+                    break
+        return out

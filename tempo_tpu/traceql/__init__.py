@@ -1,0 +1,27 @@
+"""TraceQL — the trace query language.
+
+Reference: pkg/traceql (goyacc grammar expr.y, lexer, typed AST with
+validation ast.go, condition extraction for storage pushdown
+storage.go:15-63, pipeline evaluation ast_execute.go, Engine bridging
+SearchRequest -> Fetch -> evaluate engine.go:25-108).
+
+This implementation is a recursive-descent parser (no parser generator
+needed at this grammar size) over the same language surface the
+snapshot supports:
+
+- spanset filters `{ <field expr> }` with full boolean/comparison/
+  arithmetic on intrinsics (name, duration, status, kind, parent,
+  childCount) and attributes (.k, span.k, resource.k, with string,
+  int, float, bool, duration literals and =~ regex);
+- spanset combinators && || and structural > (child) >> (descendant);
+- pipelines: `| count() > n`, `| avg(duration) > 1s`, min/max/sum,
+  `| coalesce()`.
+
+Execution follows the reference's two-phase shape: approximate
+conditions are pushed to storage (prune row groups / fetch candidate
+traces; false positives allowed), then the engine re-evaluates the
+exact expression over the candidates.
+"""
+
+from tempo_tpu.traceql.engine import Engine, execute  # noqa: F401
+from tempo_tpu.traceql.parser import ParseError, parse  # noqa: F401

@@ -1,0 +1,329 @@
+"""TraceQL typed AST + evaluation + storage condition extraction.
+
+Reference: pkg/traceql/ast.go (typed nodes + validation),
+ast_execute.go (evaluation over spansets), storage.go:15-63 (condition
+extraction: the approximate, false-positive-allowed predicate set handed
+to the storage layer; the engine re-evaluates exactly).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from tempo_tpu.model.trace import (
+    KIND_CLIENT,
+    KIND_CONSUMER,
+    KIND_INTERNAL,
+    KIND_PRODUCER,
+    KIND_SERVER,
+    KIND_UNSPECIFIED,
+    STATUS_ERROR,
+    STATUS_OK,
+    STATUS_UNSET,
+)
+
+STATUS_KEYWORDS = {"ok": STATUS_OK, "error": STATUS_ERROR, "unset": STATUS_UNSET}
+KIND_KEYWORDS = {
+    "client": KIND_CLIENT,
+    "server": KIND_SERVER,
+    "internal": KIND_INTERNAL,
+    "producer": KIND_PRODUCER,
+    "consumer": KIND_CONSUMER,
+    "unspecified": KIND_UNSPECIFIED,
+}
+
+COMPARISON_OPS = {"=", "!=", ">", ">=", "<", "<=", "=~", "!~"}
+ARITH_OPS = {"+", "-", "*", "/", "%", "^"}
+
+
+class TypeError_(Exception):
+    """Static validation failure (name avoids shadowing builtin)."""
+
+
+@dataclass(frozen=True)
+class Condition:
+    """One pushdown predicate for the storage layer.
+
+    scope: span | resource | any | intrinsic ; op None = fetch column only.
+    Storage may ignore any condition (false positives allowed) but must
+    never drop true matches when all_conditions handling is correct.
+    """
+
+    scope: str
+    name: str
+    op: str | None
+    value: object = None
+
+
+@dataclass
+class FetchSpec:
+    conditions: list = field(default_factory=list)
+    all_conditions: bool = True  # True: span must satisfy ALL conditions
+
+
+# ---------------------------------------------------------------------------
+# expression nodes (evaluated per span)
+# ---------------------------------------------------------------------------
+
+
+class Expr:
+    def eval(self, span, ctx):  # -> python value or None
+        raise NotImplementedError
+
+    def conditions(self) -> FetchSpec:
+        return FetchSpec(conditions=[], all_conditions=True)
+
+
+@dataclass
+class Literal(Expr):
+    value: object
+    kind: str  # string | int | float | bool | duration | status | kind | nil
+
+    def eval(self, span, ctx):
+        return self.value
+
+
+@dataclass
+class Attribute(Expr):
+    scope: str  # any | span | resource | parent
+    name: str
+
+    def eval(self, span, ctx):
+        if self.scope == "parent":
+            parent = ctx.parent_of(span)
+            return parent.attributes.get(self.name) if parent else None
+        if self.scope in ("any", "span"):
+            v = span.attributes.get(self.name)
+            if v is not None or self.scope == "span":
+                return v
+        return ctx.resource_of(span).get(self.name)
+
+
+@dataclass
+class Intrinsic(Expr):
+    name: str  # duration | name | status | kind | childCount | parent
+
+    def eval(self, span, ctx):
+        if self.name == "duration":
+            return span.duration_nano
+        if self.name == "name":
+            return span.name
+        if self.name == "status":
+            return span.status_code
+        if self.name == "kind":
+            return span.kind
+        if self.name == "childCount":
+            return ctx.child_count(span)
+        if self.name == "parent":
+            return ctx.parent_of(span)
+        raise TypeError_(f"unknown intrinsic {self.name}")
+
+
+@dataclass
+class Unary(Expr):
+    op: str  # - | !
+    expr: Expr
+
+    def eval(self, span, ctx):
+        v = self.expr.eval(span, ctx)
+        if v is None:
+            return None
+        if self.op == "-":
+            return -v
+        return not v
+
+
+@dataclass
+class Binary(Expr):
+    op: str
+    lhs: Expr
+    rhs: Expr
+
+    def eval(self, span, ctx):
+        op = self.op
+        if op == "&&":
+            return bool(self.lhs.eval(span, ctx)) and bool(self.rhs.eval(span, ctx))
+        if op == "||":
+            return bool(self.lhs.eval(span, ctx)) or bool(self.rhs.eval(span, ctx))
+        l = self.lhs.eval(span, ctx)
+        r = self.rhs.eval(span, ctx)
+        if op in ("=", "!="):
+            if _is_nil_literal(self.rhs) or _is_nil_literal(self.lhs):
+                target = l if _is_nil_literal(self.rhs) else r
+                return (target is None) == (op == "=")
+            if l is None or r is None:
+                return False
+            if isinstance(l, bool) != isinstance(r, bool) and not (
+                isinstance(l, (int, float)) and isinstance(r, (int, float))
+            ):
+                return False
+            eq = l == r
+            return eq if op == "=" else not eq
+        if l is None or r is None:
+            return None if op in ARITH_OPS else False
+        if op in ("=~", "!~"):
+            if not isinstance(l, str) or not isinstance(r, str):
+                return False
+            hit = re.search(r, l) is not None
+            return hit if op == "=~" else not hit
+        if op in (">", ">=", "<", "<="):
+            try:
+                return {
+                    ">": l > r,
+                    ">=": l >= r,
+                    "<": l < r,
+                    "<=": l <= r,
+                }[op]
+            except TypeError:
+                return False
+        if op in ARITH_OPS:
+            try:
+                if op == "+":
+                    return l + r
+                if op == "-":
+                    return l - r
+                if op == "*":
+                    return l * r
+                if op == "/":
+                    return l / r if r != 0 else None
+                if op == "%":
+                    return l % r if r != 0 else None
+                if op == "^":
+                    return l**r
+            except TypeError:
+                return None
+        raise TypeError_(f"unknown operator {op}")
+
+    def conditions(self) -> FetchSpec:
+        if self.op == "&&":
+            a, b = self.lhs.conditions(), self.rhs.conditions()
+            return FetchSpec(
+                conditions=a.conditions + b.conditions,
+                all_conditions=a.all_conditions and b.all_conditions,
+            )
+        if self.op == "||":
+            a, b = self.lhs.conditions(), self.rhs.conditions()
+            if not a.conditions or not b.conditions:
+                # one side is opaque -> no safe pushdown at all
+                return FetchSpec(conditions=[], all_conditions=False)
+            return FetchSpec(conditions=a.conditions + b.conditions, all_conditions=False)
+        cond = self._leaf_condition()
+        return FetchSpec(conditions=[cond] if cond else [], all_conditions=True)
+
+    def _leaf_condition(self) -> Condition | None:
+        """field <op> literal -> pushdown condition (both orders)."""
+        for fld, lit, op in ((self.lhs, self.rhs, self.op), (self.rhs, self.lhs, _flip(self.op))):
+            if not isinstance(lit, Literal) or lit.kind == "nil":
+                continue
+            if isinstance(fld, Attribute) and fld.scope in ("any", "span", "resource"):
+                if op in COMPARISON_OPS:
+                    return Condition(fld.scope, fld.name, op, lit.value)
+            if isinstance(fld, Intrinsic) and fld.name in ("duration", "name", "status", "kind"):
+                if op in COMPARISON_OPS:
+                    return Condition("intrinsic", fld.name, op, lit.value)
+        return None
+
+
+def _flip(op: str) -> str:
+    return {">": "<", "<": ">", ">=": "<=", "<=": ">="}.get(op, op)
+
+
+def _is_nil_literal(e: Expr) -> bool:
+    return isinstance(e, Literal) and e.kind == "nil"
+
+
+# ---------------------------------------------------------------------------
+# spanset-level nodes
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SpansetFilter:
+    expr: Expr | None  # None = {} match-all
+
+    def conditions(self) -> FetchSpec:
+        if self.expr is None:
+            return FetchSpec(conditions=[], all_conditions=True)
+        return self.expr.conditions()
+
+    def matches(self, spans, ctx):
+        if self.expr is None:
+            return list(spans)
+        out = []
+        for s in spans:
+            v = self.expr.eval(s, ctx)
+            if isinstance(v, bool) and v:
+                out.append(s)
+        return out
+
+
+@dataclass
+class SpansetOp:
+    op: str  # && | "||" | ">" | ">>"
+    lhs: object
+    rhs: object
+
+    def conditions(self) -> FetchSpec:
+        a, b = self.lhs.conditions(), self.rhs.conditions()
+        if self.op == "||":
+            if not a.conditions or not b.conditions:
+                return FetchSpec(conditions=[], all_conditions=False)
+            return FetchSpec(conditions=a.conditions + b.conditions, all_conditions=False)
+        # &&, >, >>: span-level conditions from either side are
+        # trace-level necessary, but no single span must satisfy all
+        return FetchSpec(conditions=a.conditions + b.conditions, all_conditions=False)
+
+
+@dataclass
+class AggregateFilter:
+    agg: str  # count | avg | min | max | sum
+    field_expr: Expr | None  # None only for count
+    op: str
+    rhs: Literal
+
+    def conditions(self) -> FetchSpec:
+        return FetchSpec(conditions=[], all_conditions=False)
+
+    def test(self, spans, ctx) -> bool:
+        if self.agg == "count":
+            val = len(spans)
+        else:
+            vals = [self.field_expr.eval(s, ctx) for s in spans]
+            vals = [v for v in vals if isinstance(v, (int, float)) and not isinstance(v, bool)]
+            if not vals:
+                return False
+            val = {
+                "avg": lambda: sum(vals) / len(vals),
+                "min": lambda: min(vals),
+                "max": lambda: max(vals),
+                "sum": lambda: sum(vals),
+            }[self.agg]()
+        r = self.rhs.value
+        return {
+            "=": val == r,
+            "!=": val != r,
+            ">": val > r,
+            ">=": val >= r,
+            "<": val < r,
+            "<=": val <= r,
+        }[self.op]
+
+
+@dataclass
+class Coalesce:
+    def conditions(self) -> FetchSpec:
+        return FetchSpec(conditions=[], all_conditions=True)
+
+
+@dataclass
+class Pipeline:
+    stages: list  # spanset expr first, then AggregateFilter/Coalesce
+
+    def conditions(self) -> FetchSpec:
+        spec = self.stages[0].conditions()
+        if len(self.stages) > 1:
+            # later stages can only drop spansets; span-level pushdown from
+            # the first stage remains valid
+            pass
+        return spec

@@ -1,0 +1,162 @@
+"""TraceQL execution engine.
+
+Reference: pkg/traceql/engine.go:25-108 (Execute: parse -> extract fetch
+conditions -> storage Fetch -> evaluate pipeline per spanset) and
+ast_execute.go (spanset algebra).
+
+The fetcher contract: fetch(spec: FetchSpec, start_s, end_s) returns
+candidate Trace objects (false positives fine — the engine re-evaluates
+the exact expression; traces straddling blocks must arrive combined).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tempo_tpu.traceql import ast_nodes as A
+from tempo_tpu.traceql.parser import parse
+
+
+class EvalContext:
+    """Per-trace evaluation context: parent links, children counts,
+    resource attrs per span."""
+
+    def __init__(self, trace):
+        self.trace = trace
+        self._by_id = {}
+        self._resource = {}
+        self._children = {}
+        for resource, spans in trace.batches:
+            for s in spans:
+                self._by_id[s.span_id] = s
+                self._resource[s.span_id] = resource
+        for s in self.all_spans():
+            self._children[s.parent_span_id] = self._children.get(s.parent_span_id, 0) + 1
+
+    def all_spans(self):
+        return list(self._by_id.values())
+
+    def parent_of(self, span):
+        return self._by_id.get(span.parent_span_id)
+
+    def resource_of(self, span):
+        return self._resource.get(span.span_id, {})
+
+    def child_count(self, span):
+        return self._children.get(span.span_id, 0)
+
+    def ancestors(self, span):
+        seen = set()
+        p = self.parent_of(span)
+        while p is not None and p.span_id not in seen:
+            seen.add(p.span_id)
+            yield p
+            p = self.parent_of(p)
+
+
+def eval_spanset_expr(node, spans, ctx):
+    if isinstance(node, A.SpansetFilter):
+        return node.matches(spans, ctx)
+    if isinstance(node, A.SpansetOp):
+        a = eval_spanset_expr(node.lhs, spans, ctx)
+        b = eval_spanset_expr(node.rhs, spans, ctx)
+        if node.op == "&&":
+            return _union(a, b) if a and b else []
+        if node.op == "||":
+            return _union(a, b)
+        if node.op == ">":
+            a_ids = {s.span_id for s in a}
+            return [s for s in b if s.parent_span_id in a_ids]
+        if node.op == ">>":
+            a_ids = {s.span_id for s in a}
+            return [s for s in b if any(p.span_id in a_ids for p in ctx.ancestors(s))]
+        raise A.TypeError_(f"unknown spanset op {node.op}")
+    raise A.TypeError_(f"unexpected spanset node {node}")
+
+
+def _union(a, b):
+    seen = set()
+    out = []
+    for s in list(a) + list(b):
+        if s.span_id not in seen:
+            seen.add(s.span_id)
+            out.append(s)
+    return out
+
+
+@dataclass
+class SpansetResult:
+    trace_id_hex: str
+    root_service_name: str = ""
+    root_trace_name: str = ""
+    start_time_unix_nano: int = 0
+    duration_ms: int = 0
+    spans: list = field(default_factory=list)  # matched Span objects
+
+    def to_dict(self):
+        return {
+            "traceID": self.trace_id_hex,
+            "rootServiceName": self.root_service_name,
+            "rootTraceName": self.root_trace_name,
+            "startTimeUnixNano": str(self.start_time_unix_nano),
+            "durationMs": self.duration_ms,
+            "spanSet": {
+                "matched": len(self.spans),
+                "spans": [
+                    {
+                        "spanID": s.span_id.hex(),
+                        "name": s.name,
+                        "startTimeUnixNano": str(s.start_unix_nano),
+                        "durationNanos": str(s.duration_nano),
+                    }
+                    for s in self.spans[:20]
+                ],
+            },
+        }
+
+
+class Engine:
+    def execute(self, query: str, fetch, start_s: int = 0, end_s: int = 0,
+                limit: int = 20) -> list[SpansetResult]:
+        pipeline = parse(query)
+        spec = pipeline.conditions()
+        results = []
+        for trace in fetch(spec, start_s, end_s):
+            ctx = EvalContext(trace)
+            spans = ctx.all_spans()
+            matched = eval_spanset_expr(pipeline.stages[0], spans, ctx)
+            ok = bool(matched)
+            for stage in pipeline.stages[1:]:
+                if not ok:
+                    break
+                if isinstance(stage, A.AggregateFilter):
+                    ok = stage.test(matched, ctx)
+                elif isinstance(stage, A.Coalesce):
+                    pass  # spansets are already per-trace merged here
+            if not ok:
+                continue
+            results.append(_to_result(trace, matched, ctx))
+            if limit and len(results) >= limit:
+                break
+        results.sort(key=lambda r: -r.start_time_unix_nano)
+        return results
+
+
+def _to_result(trace, matched, ctx) -> SpansetResult:
+    spans = ctx.all_spans()
+    start = min(s.start_unix_nano for s in spans)
+    end = max(s.end_unix_nano for s in spans)
+    roots = [s for s in spans if s.parent_span_id == b"\x00" * 8]
+    root = roots[0] if roots else spans[0]
+    return SpansetResult(
+        trace_id_hex=trace.trace_id.hex(),
+        root_service_name=ctx.resource_of(root).get("service.name", ""),
+        root_trace_name=root.name,
+        start_time_unix_nano=start,
+        duration_ms=(end - start) // 10**6,
+        spans=sorted(matched, key=lambda s: s.start_unix_nano),
+    )
+
+
+def execute(query: str, fetch, **kw) -> list[SpansetResult]:
+    return Engine().execute(query, fetch, **kw)

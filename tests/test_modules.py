@@ -1,0 +1,319 @@
+"""Service-module tests: ring math, overrides reload, the full
+distributor -> ingester -> WAL -> block -> query write path (in-process
+all-in-one, the reference's TestAllInOne shape), frontend sharding,
+fair queue, generator processors."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from tempo_tpu.app import App, AppConfig
+from tempo_tpu.db import DBConfig
+from tempo_tpu.encoding.common import SearchRequest
+from tempo_tpu.model import synth
+from tempo_tpu.model import trace as tr
+from tempo_tpu.modules.distributor import RateLimited
+from tempo_tpu.modules.frontend import create_block_boundaries
+from tempo_tpu.modules.ingester import MaxLiveTraces, TraceTooLarge
+from tempo_tpu.modules.overrides import Limits, Overrides
+from tempo_tpu.modules.queue import RequestQueue, TooManyRequests
+from tempo_tpu.modules.ring import FileKV, MemoryKV, Ring
+
+
+def make_app(tmp_path, **kw):
+    defaults = dict(
+        db=DBConfig(backend="local", backend_path=str(tmp_path / "blocks"),
+                    wal_path=str(tmp_path / "wal")),
+    )
+    defaults.update(kw)
+    return App(AppConfig(**defaults))
+
+
+class TestRing:
+    def test_replicas_distinct_and_stable(self):
+        ring = Ring(MemoryKV(), replication_factor=3)
+        for i in range(5):
+            ring.register(f"ing-{i}")
+        reps = ring.get_replicas(12345)
+        assert len(reps) == 3
+        assert len({r.instance_id for r in reps}) == 3
+        assert [r.instance_id for r in ring.get_replicas(12345)] == [
+            r.instance_id for r in reps
+        ]
+
+    def test_distribution_roughly_uniform(self):
+        ring = Ring(MemoryKV(), replication_factor=1)
+        for i in range(4):
+            ring.register(f"ing-{i}")
+        counts = {}
+        rng = np.random.default_rng(0)
+        for t in rng.integers(0, 2**32, 4000):
+            iid = ring.get_replicas(int(t))[0].instance_id
+            counts[iid] = counts.get(iid, 0) + 1
+        assert len(counts) == 4
+        assert min(counts.values()) > 4000 / 4 * 0.5  # no pathological skew
+
+    def test_unhealthy_skipped(self):
+        ring = Ring(MemoryKV(), replication_factor=1, heartbeat_timeout_s=0.1)
+        ring.register("a")
+        ring.register("b")
+        # age out a's heartbeat
+        ring.kv.update(lambda s: {**s, "a": {**s["a"], "heartbeat": time.time() - 10}})
+        for t in (1, 2**31, 2**32 - 5):
+            assert ring.get_replicas(t)[0].instance_id == "b"
+
+    def test_file_kv_shared(self, tmp_path):
+        path = str(tmp_path / "ring.json")
+        r1 = Ring(FileKV(path))
+        r2 = Ring(FileKV(path))
+        r1.register("a")
+        assert [i.instance_id for i in r2.instances()] == ["a"]
+
+    def test_shuffle_shard_deterministic(self):
+        ring = Ring(MemoryKV())
+        for i in range(6):
+            ring.register(f"g-{i}")
+        s1 = [i.instance_id for i in ring.shuffle_shard("tenant-x", 2)]
+        s2 = [i.instance_id for i in ring.shuffle_shard("tenant-x", 2)]
+        assert s1 == s2 and len(s1) == 2
+
+    def test_owns_partitions_work(self):
+        ring = Ring(MemoryKV())
+        ring.register("c-0")
+        ring.register("c-1")
+        owned = {"c-0": 0, "c-1": 0}
+        for h in range(200):
+            for iid in owned:
+                if ring.owns(iid, h * 21652301):
+                    owned[iid] += 1
+        assert sum(owned.values()) == 200  # exactly one owner each
+        assert min(owned.values()) > 0
+
+
+class TestOverrides:
+    def test_defaults_and_per_tenant(self, tmp_path):
+        p = tmp_path / "overrides.json"
+        p.write_text(json.dumps({"overrides": {"acme": {"max_traces_per_user": 7}}}))
+        ov = Overrides(Limits(max_traces_per_user=100), str(p))
+        assert ov.for_tenant("acme").max_traces_per_user == 7
+        assert ov.for_tenant("other").max_traces_per_user == 100
+
+    def test_hot_reload(self, tmp_path):
+        p = tmp_path / "overrides.json"
+        p.write_text(json.dumps({"overrides": {}}))
+        ov = Overrides(Limits(), str(p))
+        assert ov.for_tenant("a").max_traces_per_user == 10_000
+        time.sleep(0.02)
+        p.write_text(json.dumps({"overrides": {"a": {"max_traces_per_user": 1}}}))
+        import os
+
+        os.utime(p, (time.time() + 5, time.time() + 5))
+        ov.maybe_reload()
+        assert ov.for_tenant("a").max_traces_per_user == 1
+
+    def test_unknown_key_keeps_previous(self, tmp_path):
+        p = tmp_path / "overrides.json"
+        p.write_text(json.dumps({"overrides": {"a": {"max_traces_per_user": 5}}}))
+        ov = Overrides(Limits(), str(p))
+        assert ov.for_tenant("a").max_traces_per_user == 5
+        p.write_text(json.dumps({"overrides": {"a": {"not_a_knob": 1}}}))
+        import os
+
+        os.utime(p, (time.time() + 5, time.time() + 5))
+        ov.maybe_reload()
+        assert ov.for_tenant("a").max_traces_per_user == 5  # kept previous good
+
+    def test_global_rate_strategy(self):
+        ov = Overrides(Limits(ingestion_rate_limit_bytes=100, ingestion_rate_strategy="global"))
+        assert ov.ingestion_rate_bytes("t", ring_size=4) == 25
+
+
+class TestAllInOne:
+    """Push -> live query -> cut/flush -> backend query -> compact ->
+    query again, all through the composed app."""
+
+    def test_write_then_read(self, tmp_path):
+        app = make_app(tmp_path)
+        traces = synth.make_traces(12, seed=50)
+        app.push_traces(traces)
+        # live: findable via ingester before any cut
+        got = app.find_trace(traces[0].trace_id)
+        assert got is not None and got.span_count() == traces[0].span_count()
+
+        app.sweep_all(immediate=True)  # cut + complete + flush
+        assert len(app.db.blocklist.metas("single-tenant")) >= 1
+        got = app.find_trace(traces[5].trace_id)
+        assert got is not None and got.span_count() == traces[5].span_count()
+
+        svc = traces[0].batches[0][0]["service.name"]
+        resp = app.search(SearchRequest(tags={"service.name": svc}, limit=0))
+        want = {
+            t.trace_id.hex() for t in traces
+            if any(r.get("service.name") == svc for r, _ in t.batches)
+        }
+        assert {m.trace_id_hex for m in resp.traces} == want
+        app.shutdown()
+
+    def test_replication_factor_dedupe(self, tmp_path):
+        app = make_app(tmp_path, n_ingesters=3, replication_factor=2)
+        traces = synth.make_traces(10, seed=51)
+        app.push_traces(traces)
+        app.sweep_all(immediate=True)
+        app.db.compact_once("single-tenant")
+        for t in traces[:5]:
+            got = app.find_trace(t.trace_id)
+            assert got is not None
+            assert got.span_count() == t.span_count()  # RF copies deduped
+        app.shutdown()
+
+    def test_traceql_through_app(self, tmp_path):
+        app = make_app(tmp_path)
+        traces = synth.make_traces(10, seed=52)
+        app.push_traces(traces)
+        app.sweep_all(immediate=True)
+        res = app.traceql("{ status = error }", limit=0)
+        want = {
+            t.trace_id.hex() for t in traces
+            if any(s.status_code == 2 for s in t.all_spans())
+        }
+        assert {r.trace_id_hex for r in res} == want
+        app.shutdown()
+
+    def test_live_search_before_flush(self, tmp_path):
+        app = make_app(tmp_path)
+        traces = synth.make_traces(6, seed=53)
+        app.push_traces(traces)
+        svc = traces[0].batches[0][0]["service.name"]
+        resp = app.search(SearchRequest(tags={"service.name": svc}, limit=0))
+        assert resp.traces  # found in live data
+        app.shutdown()
+
+    def test_multitenancy(self, tmp_path):
+        app = make_app(tmp_path, multitenancy_enabled=True)
+        traces = synth.make_traces(3, seed=54)
+        app.push_traces(traces, org_id="team-a")
+        with pytest.raises(PermissionError):
+            app.push_traces(traces)
+        assert app.find_trace(traces[0].trace_id, org_id="team-b") is None
+        assert app.find_trace(traces[0].trace_id, org_id="team-a") is not None
+        app.shutdown()
+
+
+class TestIngestLimits:
+    def test_rate_limit(self, tmp_path):
+        app = make_app(tmp_path, limits=Limits(ingestion_rate_limit_bytes=10, ingestion_burst_size_bytes=10))
+        with pytest.raises(RateLimited):
+            app.push_traces(synth.make_traces(5, seed=55))
+        app.shutdown()
+
+    def test_max_live_traces(self, tmp_path):
+        app = make_app(tmp_path, limits=Limits(max_traces_per_user=2))
+        with pytest.raises(Exception) as ei:
+            app.push_traces(synth.make_traces(5, seed=56))
+        assert "max live traces" in str(ei.value) or isinstance(ei.value, MaxLiveTraces)
+        app.shutdown()
+
+    def test_trace_too_large(self, tmp_path):
+        app = make_app(tmp_path, limits=Limits(max_spans_per_trace=3))
+        with pytest.raises(Exception) as ei:
+            app.push_traces(synth.make_traces(1, seed=57, spans_per_trace=10))
+        assert "spans" in str(ei.value)
+        app.shutdown()
+
+
+class TestWalRecovery:
+    def test_ingester_crash_replay(self, tmp_path):
+        app = make_app(tmp_path)
+        traces = synth.make_traces(8, seed=58)
+        app.push_traces(traces)
+        # cut to WAL but "crash" before complete/flush
+        for ing in app.ingesters.values():
+            for inst in ing.instances.values():
+                inst.cut_complete_traces(immediate=True)
+                inst.cut_block_if_ready(immediate=True)
+        # new app over the same dirs (same wal subdirs via instance ids)
+        app2 = make_app(tmp_path)
+        app2.sweep_all(immediate=True)  # replayed blocks complete+flush
+        app2.db.poll_now()
+        got = app2.find_trace(traces[3].trace_id)
+        assert got is not None and got.span_count() == traces[3].span_count()
+        app.shutdown()
+        app2.shutdown()
+
+
+class TestFrontend:
+    def test_block_boundaries_uniform(self):
+        b = create_block_boundaries(4)
+        assert b[0] == "0" * 32 and b[-1] == "f" * 32
+        assert len(b) == 5
+        assert b == sorted(b)
+
+    def test_queue_fairness(self):
+        q = RequestQueue(max_per_tenant=100)
+        order = []
+        for i in range(3):
+            q.enqueue("heavy", lambda i=i: order.append(("heavy", i)))
+        q.enqueue("light", lambda: order.append(("light", 0)))
+        for _ in range(4):
+            tenant, job = q.dequeue(timeout=0.1)
+            job()
+        # light tenant is served before heavy drains completely
+        assert order.index(("light", 0)) < 3
+
+    def test_queue_backpressure(self):
+        q = RequestQueue(max_per_tenant=2)
+        q.enqueue("t", lambda: None)
+        q.enqueue("t", lambda: None)
+        with pytest.raises(TooManyRequests):
+            q.enqueue("t", lambda: None)
+
+
+class TestGenerator:
+    def test_spanmetrics_counts(self, tmp_path):
+        app = make_app(tmp_path)
+        traces = synth.make_traces(10, seed=59)
+        app.push_traces(traces)
+        reg = app.generator.instance("single-tenant").registry
+        samples = {s.name: 0.0 for s in reg.collect()}
+        total_calls = sum(
+            s.value for s in reg.collect() if s.name == "traces_spanmetrics_calls_total"
+        )
+        assert total_calls == sum(t.span_count() for t in traces)
+        assert any(s.name.startswith("traces_spanmetrics_latency") for s in reg.collect())
+        app.shutdown()
+
+    def test_servicegraph_edges(self):
+        from tempo_tpu.modules.generator.registry import ManagedRegistry
+        from tempo_tpu.modules.generator.servicegraphs import ServiceGraphsProcessor
+
+        reg = ManagedRegistry("t")
+        p = ServiceGraphsProcessor(reg)
+        tid = b"\x07" * 16
+        client = tr.Span(trace_id=tid, span_id=b"\x01" * 8, name="call",
+                         kind=tr.KIND_CLIENT, duration_nano=10**8)
+        server = tr.Span(trace_id=tid, span_id=b"\x02" * 8, parent_span_id=b"\x01" * 8,
+                         name="serve", kind=tr.KIND_SERVER, duration_nano=5 * 10**7,
+                         status_code=2)
+        t1 = tr.Trace(trace_id=tid, batches=[({"service.name": "A"}, [client])])
+        t2 = tr.Trace(trace_id=tid, batches=[({"service.name": "B"}, [server])])
+        p.push(tr.traces_to_batch([t1]))
+        p.push(tr.traces_to_batch([t2]))
+        assert p.edges_emitted == 1
+        vals = {(s.name, s.labels): s.value for s in reg.collect()}
+        assert vals[("traces_service_graph_request_total", (("client", "A"), ("server", "B")))] == 1.0
+        assert vals[("traces_service_graph_request_failed_total", (("client", "A"), ("server", "B")))] == 1.0
+        assert p.distinct_edges_estimate() >= 1.0
+
+    def test_registry_staleness_and_limits(self):
+        from tempo_tpu.modules.generator.registry import ManagedRegistry
+
+        reg = ManagedRegistry("t", max_active_series=2, stale_after_s=1.0)
+        reg.inc_counter("m", (("a", "1"),), 1, now=100.0)
+        reg.inc_counter("m", (("a", "2"),), 1, now=100.0)
+        reg.inc_counter("m", (("a", "3"),), 1, now=100.0)  # over limit -> dropped
+        assert reg.active_series() == 2
+        assert reg.series_dropped == 1
+        assert reg.remove_stale(now=102.0) == 2
+        assert reg.active_series() == 0
