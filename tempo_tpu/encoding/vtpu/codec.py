@@ -2,10 +2,11 @@
 
 Fills the role of the reference's compression pools
 (tempodb/encoding/v2/pool.go:96-405 — gzip/lz4/snappy/zstd/s2 readers
-and writers) for column pages. Codecs: none, zlib (stdlib), zstd
-(python-zstandard, present in the image), and "native" — the C++ codec
-library (tempo_tpu/native) when built, which also does CRC and
-delta/varint transforms off the GIL.
+and writers) for column pages. Codecs: none, zlib (stdlib fallback),
+zstd (via the native C++ library tempo_tpu/native, linked against
+system libzstd). The native path also computes CRCs and runs off the
+GIL; when g++ or libzstd is unavailable the zlib/stdlib path keeps the
+format readable (zstd pages then require the native lib).
 
 Every page carries a crc32 in the index so torn reads/corruption are
 detected at decode time (reference: v2 pages carry CRC,
@@ -18,47 +19,66 @@ import zlib
 
 import numpy as np
 
-try:
-    import zstandard as _zstd
-
-    _ZSTD_C = _zstd.ZstdCompressor(level=3)
-    _ZSTD_D = _zstd.ZstdDecompressor()
-except Exception:  # pragma: no cover
-    _zstd = None
+from tempo_tpu import native
 
 CODECS = ("none", "zlib", "zstd")
+DEFAULT_CODEC = "zstd"
 
 
 class CorruptPage(Exception):
     pass
 
 
+def best_codec() -> str:
+    """zstd when the native lib is up, else zlib."""
+    return "zstd" if native.lib() is not None else "zlib"
+
+
+def resolve_codec(codec: str) -> str:
+    return best_codec() if codec == "auto" else codec
+
+
 def encode(arr: np.ndarray, codec: str) -> tuple[bytes, int]:
     """array -> (page bytes, crc32 of uncompressed payload)."""
     raw = np.ascontiguousarray(arr).tobytes()
-    crc = zlib.crc32(raw)
+    nat = native.lib()
     if codec == "none":
+        crc = nat.crc32(raw) if nat else zlib.crc32(raw)
         return raw, crc
     if codec == "zlib":
-        return zlib.compress(raw, 1), crc
+        if nat is not None:
+            return nat.compress(raw, "zlib", 1), nat.crc32(raw)
+        return zlib.compress(raw, 1), zlib.crc32(raw)
     if codec == "zstd":
-        if _zstd is None:
-            raise ValueError("zstd not available")
-        return _ZSTD_C.compress(raw), crc
+        if nat is None:
+            raise ValueError("zstd codec requires the native library (g++ + libzstd)")
+        return nat.compress(raw, "zstd", 3), nat.crc32(raw)
     raise ValueError(f"unknown codec {codec!r}")
 
 
 def decode(page: bytes, dtype: str, shape: tuple, codec: str, crc: int | None = None) -> np.ndarray:
+    nat = native.lib()
+    raw_len = int(np.prod(shape)) * np.dtype(dtype).itemsize if shape else np.dtype(dtype).itemsize
     if codec == "none":
         raw = page
     elif codec == "zlib":
-        raw = zlib.decompress(page)
+        if nat is not None:
+            try:
+                raw = nat.decompress(page, raw_len, "zlib")
+            except native.NativeError as e:
+                raise CorruptPage(str(e)) from e
+        else:
+            raw = zlib.decompress(page)
     elif codec == "zstd":
-        if _zstd is None:
-            raise ValueError("zstd not available")
-        raw = _ZSTD_D.decompress(page)
+        if nat is None:
+            raise ValueError("zstd codec requires the native library (g++ + libzstd)")
+        try:
+            raw = nat.decompress(page, raw_len, "zstd")
+        except native.NativeError as e:
+            raise CorruptPage(str(e)) from e
     else:
         raise ValueError(f"unknown codec {codec!r}")
-    if crc is not None and zlib.crc32(raw) != crc:
+    actual_crc = nat.crc32(raw) if nat else zlib.crc32(raw)
+    if crc is not None and actual_crc != crc:
         raise CorruptPage(f"crc mismatch for page ({len(page)} bytes, codec={codec})")
     return np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(shape)

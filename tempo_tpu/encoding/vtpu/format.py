@@ -121,6 +121,7 @@ def serialize_row_group(batch: SpanBatch, lo: int, hi: int, base_offset: int,
     Row indices in the attr pages are rebased to the row group start so
     each row group decodes standalone.
     """
+    codec = codec_mod.resolve_codec(codec)
     n = hi - lo
     owner = batch.attrs["attr_span"]
     amask = (owner >= lo) & (owner < hi)
@@ -203,13 +204,14 @@ def row_group_slices(batch: SpanBatch, target_spans: int) -> list[tuple[int, int
 # ---------------------------------------------------------------------------
 
 
-def serialize_batch(batch: SpanBatch, codec: str = "zlib") -> bytes:
+def serialize_batch(batch: SpanBatch, codec: str = "auto") -> bytes:
     """Self-contained segment: MAGIC | u32 header_len | header json | pages.
 
     The WAL appends one segment per trace-cut flush
     (reference analog: vparquet WAL writes one parquet file per flush,
     tempodb/encoding/vparquet/wal_block.go:309-386).
     """
+    codec = codec_mod.resolve_codec(codec)
     pages = []
     header_cols = {}
     for group, schema in (("cols", SPAN_COLUMNS), ("attrs", ATTR_COLUMNS)):

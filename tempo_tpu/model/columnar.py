@@ -200,22 +200,22 @@ class SpanBatch:
             attrs = _empty_cols(ATTR_COLUMNS)
         return SpanBatch(cols=cols, attrs=attrs, dictionary=self.dictionary)
 
+    def trace_sort_perm(self) -> np.ndarray:
+        """Permutation ordering rows by (trace_id, span_id) — block
+        storage order. Exposed so callers can reorder parallel arrays
+        (masks) with the same permutation."""
+        keys = np.concatenate([self.cols["trace_id"], self.cols["span_id"]], axis=1)
+        return np.lexsort(tuple(keys[:, i] for i in reversed(range(keys.shape[1]))))
+
     def sorted_by_trace(self) -> "SpanBatch":
         """Rows ordered by (trace_id, span_id) — block storage order."""
-        keys = np.concatenate([self.cols["trace_id"], self.cols["span_id"]], axis=1)
-        perm = np.lexsort(tuple(keys[:, i] for i in reversed(range(6))))
-        return self.select(perm)
+        return self.select(self.trace_sort_perm())
 
     def trace_boundaries(self) -> tuple[np.ndarray, np.ndarray]:
         """(first_row_of_each_trace, segment_id_per_span); rows must be
         sorted by trace."""
-        t = self.cols["trace_id"]
-        if len(t) == 0:
-            return np.empty(0, np.int64), np.empty(0, np.int64)
-        new = np.ones(len(t), dtype=bool)
-        new[1:] = (t[1:] != t[:-1]).any(axis=1)
-        seg = np.cumsum(new) - 1
-        return np.flatnonzero(new), seg
+        _, seg, firsts = trace_segmentation(self.cols["trace_id"])
+        return firsts, seg
 
     @staticmethod
     def concat(batches: list["SpanBatch"]) -> "SpanBatch":

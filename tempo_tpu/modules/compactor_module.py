@@ -27,8 +27,10 @@ class CompactorModule:
         self.db = db
         self.ring = ring
         self.instance_id = instance_id
+        self._heartbeat_stop = None
         if ring is not None:
             ring.register(instance_id)
+            self._heartbeat_stop = ring.start_heartbeat(instance_id)
         self.driver = CompactionDriver(db, db.compaction_cfg, owns=self.owns)
         self.cycle_s = cycle_s or db.compaction_cfg.cycle_s
         self._stop = threading.Event()
@@ -64,5 +66,7 @@ class CompactorModule:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
+        if self._heartbeat_stop is not None:
+            self._heartbeat_stop.set()
         if self.ring is not None:
             self.ring.unregister(self.instance_id)

@@ -124,7 +124,11 @@ class Frontend:
                 )
             )
         results, errors = self._run_jobs(tenant, jobs)
-        if errors and not results:
+        if errors:
+            # a failed shard could hide spans of this trace; fail the whole
+            # query rather than return a silently incomplete trace (the
+            # reference fails the request when any sub-request exhausts
+            # retries, frontend retry.go + deduper)
             raise errors[0]
         return combine_traces([r for r in results if r is not None])
 
@@ -160,7 +164,7 @@ class Frontend:
             jobs.append(self._block_group_job(tenant, group, req))
 
         results, errors = self._run_jobs(tenant, jobs)
-        if errors and not results:
+        if errors:
             raise errors[0]
         out = SearchResponse()
         for r in results:

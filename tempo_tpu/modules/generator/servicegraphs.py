@@ -12,6 +12,7 @@ BASELINE.json north-star metric for this processor.
 
 from __future__ import annotations
 
+import hashlib
 import time
 
 import numpy as np
@@ -92,10 +93,12 @@ class ServiceGraphsProcessor:
         counts[bidx] = 1
         self.registry.observe_histogram(REQ_SECONDS, labels, self.bounds, counts, dur_s, 1)
         self.edges_emitted += 1
-        # sketch update batched in _flush_sketches
-        h = np.frombuffer(
-            (client_svc + "\x00" + server_svc).encode()[:16].ljust(16, b"\x00"), dtype=">u4"
-        ).astype(np.uint32)
+        # sketch update batched in _flush_sketches; hash the full pair so
+        # long client names don't truncate away the server half of the key
+        digest = hashlib.blake2s(
+            (client_svc + "\x00" + server_svc).encode(), digest_size=16
+        ).digest()
+        h = np.frombuffer(digest, dtype=">u4").astype(np.uint32)
         self._edge_keys.append(h)
 
     def _flush_sketches(self):

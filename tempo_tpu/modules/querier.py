@@ -158,11 +158,9 @@ def _search_batch(batch: SpanBatch, req: SearchRequest) -> SearchResponse:
     if not mask.any():
         return resp
 
-    sb = batch.sorted_by_trace()
-    # recompute mask on sorted batch via trace+span identity is overkill;
-    # instead sort the mask with the same permutation the sort used
-    keys = np.concatenate([batch.cols["trace_id"], batch.cols["span_id"]], axis=1)
-    perm = np.lexsort(tuple(keys[:, i] for i in reversed(range(6))))
+    # one permutation for both the rows and the mask
+    perm = batch.trace_sort_perm()
+    sb = batch.select(perm)
     smask = mask[perm]
     from tempo_tpu.model.columnar import hit_trace_mask, trace_segmentation
 

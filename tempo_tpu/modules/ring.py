@@ -12,6 +12,7 @@ any networked KV can implement the same 3-method interface.
 from __future__ import annotations
 
 import bisect
+import fcntl
 import json
 import os
 import random
@@ -78,17 +79,39 @@ class FileKV(KVStore):
             return {}
 
     def update(self, mutate):
+        # cross-process flock around the read-modify-write: without it two
+        # processes registering concurrently each write a state containing
+        # only themselves and the last writer wins
         with self._lock:
-            cur = self.get()
-            new = mutate(cur)
-            tmp = f"{self.path}.tmp.{os.getpid()}"
-            with open(tmp, "w") as f:
-                json.dump(new, f)
-            os.replace(tmp, self.path)
-            return new
+            lockpath = f"{self.path}.lock"
+            with open(lockpath, "w") as lockf:
+                fcntl.flock(lockf, fcntl.LOCK_EX)
+                try:
+                    cur = self.get()
+                    new = mutate(cur)
+                    tmp = f"{self.path}.tmp.{os.getpid()}"
+                    with open(tmp, "w") as f:
+                        json.dump(new, f)
+                    os.replace(tmp, self.path)
+                    return new
+                finally:
+                    fcntl.flock(lockf, fcntl.LOCK_UN)
 
 
 NUM_TOKENS = 128
+
+
+class _JoiningStopEvent(threading.Event):
+    """Stop event that joins its loop thread on set(), so a mid-flight
+    heartbeat can't re-register an instance after unregister runs."""
+
+    _thread: threading.Thread | None = None
+
+    def set(self) -> None:
+        super().set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=15)
 
 
 class Ring:
@@ -97,10 +120,12 @@ class Ring:
         self.kv = kv
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.replication_factor = replication_factor
+        self._unregistered: set[str] = set()
 
     # -- membership (Lifecycler role) -----------------------------------
     def register(self, instance_id: str, addr: str = "", n_tokens: int = NUM_TOKENS,
                  seed: int | None = None) -> None:
+        self._unregistered.discard(instance_id)
         rng = random.Random(seed if seed is not None else instance_id)
         tokens = sorted(rng.randrange(0, 2**32) for _ in range(n_tokens))
 
@@ -119,9 +144,17 @@ class Ring:
         def mutate(state):
             if instance_id in state:
                 state[instance_id]["heartbeat"] = time.time()
+            else:
+                # lost registration (e.g. ring state wiped or raced away):
+                # re-register rather than silently stay absent forever —
+                # unless this process explicitly unregistered it
+                missing.append(instance_id)
             return state
 
+        missing: list[str] = []
         self.kv.update(mutate)
+        if missing and instance_id not in self._unregistered:
+            self.register(instance_id)
 
     def set_state(self, instance_id: str, st: str) -> None:
         def mutate(state):
@@ -132,6 +165,7 @@ class Ring:
         self.kv.update(mutate)
 
     def unregister(self, instance_id: str) -> None:
+        self._unregistered.add(instance_id)
         def mutate(state):
             state.pop(instance_id, None)
             return state
@@ -174,7 +208,7 @@ class Ring:
         stop event. Without this, the instance ages out of the healthy
         set after heartbeat_timeout_s (reference: dskit Lifecycler's
         heartbeat loop)."""
-        stop = threading.Event()
+        stop = _JoiningStopEvent()
 
         def loop():
             while not stop.wait(period_s):
@@ -183,7 +217,9 @@ class Ring:
                 except Exception:
                     pass
 
-        threading.Thread(target=loop, daemon=True, name=f"heartbeat-{instance_id}").start()
+        t = threading.Thread(target=loop, daemon=True, name=f"heartbeat-{instance_id}")
+        stop._thread = t
+        t.start()
         return stop
 
     def shuffle_shard(self, key: str, size: int) -> list[InstanceDesc]:

@@ -1,0 +1,228 @@
+"""ctypes bindings for the native C++ codec library.
+
+The library is compiled on demand with g++ (cached next to the source,
+keyed by source hash) and loaded via ctypes — no pybind11 in this image.
+All entry points hold no Python state and release the GIL for the
+duration of the C call (ctypes does this for us), so page encode/decode
+and k-way merge planning run concurrently with device work.
+
+`lib()` returns the loaded binding or None when no compiler/headers are
+available; callers (encoding/vtpu/codec.py) fall back to stdlib paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "codec.cc")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+class NativeError(Exception):
+    pass
+
+
+ERR = {-1: "destination too small", -2: "corrupt input", -3: "bad argument"}
+
+
+def _check(r: int) -> int:
+    if r < 0:
+        raise NativeError(ERR.get(r, f"native error {r}"))
+    return r
+
+
+def _build() -> str | None:
+    with open(_SRC, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    so = os.path.join(_DIR, f"_codec_{tag}.so")
+    if os.path.exists(so):
+        return so
+    tmp = f"{so}.{os.getpid()}.tmp"  # pid-suffixed: concurrent first-use
+    # builds from sibling processes must not interleave into one file
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+        _SRC, "-o", tmp, "-lzstd", "-lz",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except Exception:
+        return so if os.path.exists(so) else None  # a sibling may have won
+    os.replace(tmp, so)
+    # drop stale builds
+    for f in os.listdir(_DIR):
+        if f.startswith("_codec_") and f.endswith(".so") and f != os.path.basename(so):
+            try:
+                os.unlink(os.path.join(_DIR, f))
+            except OSError:
+                pass
+    return so
+
+
+class _Binding:
+    def __init__(self, so_path: str):
+        self.path = so_path
+        lib = ctypes.CDLL(so_path)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        self._crc32 = lib.ttpu_crc32
+        self._crc32.restype = ctypes.c_uint32
+        self._crc32.argtypes = [u8p, ctypes.c_size_t]
+        self._hash64 = lib.ttpu_hash64
+        self._hash64.restype = ctypes.c_uint64
+        self._hash64.argtypes = [u8p, ctypes.c_size_t, ctypes.c_uint64]
+        self._zstd_bound = lib.ttpu_zstd_bound
+        self._zstd_bound.restype = ctypes.c_size_t
+        self._zstd_bound.argtypes = [ctypes.c_size_t]
+        for name in ("zstd_compress", "zlib_compress"):
+            fn = getattr(lib, f"ttpu_{name}")
+            fn.restype = ctypes.c_longlong
+            fn.argtypes = [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t, ctypes.c_int]
+            setattr(self, f"_{name}", fn)
+        for name in ("zstd_decompress", "zlib_decompress"):
+            fn = getattr(lib, f"ttpu_{name}")
+            fn.restype = ctypes.c_longlong
+            fn.argtypes = [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t]
+            setattr(self, f"_{name}", fn)
+        self._zlib_bound = lib.ttpu_zlib_bound
+        self._zlib_bound.restype = ctypes.c_size_t
+        self._zlib_bound.argtypes = [ctypes.c_size_t]
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        self._venc = lib.ttpu_varint_encode_i64
+        self._venc.restype = ctypes.c_longlong
+        self._venc.argtypes = [i64p, ctypes.c_size_t, u8p, ctypes.c_size_t]
+        self._vdec = lib.ttpu_varint_decode_i64
+        self._vdec.restype = ctypes.c_longlong
+        self._vdec.argtypes = [u8p, ctypes.c_size_t, i64p, ctypes.c_size_t]
+        self._penc = lib.ttpu_page_encode
+        self._penc.restype = ctypes.c_longlong
+        self._penc.argtypes = [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t,
+                               ctypes.c_int, ctypes.c_int]
+        self._praw = lib.ttpu_page_raw_len
+        self._praw.restype = ctypes.c_longlong
+        self._praw.argtypes = [u8p, ctypes.c_size_t]
+        self._pdec = lib.ttpu_page_decode
+        self._pdec.restype = ctypes.c_longlong
+        self._pdec.argtypes = [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t]
+        u64pp = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64))
+        self._kway = lib.ttpu_kway_merge_u128
+        self._kway.restype = ctypes.c_longlong
+        self._kway.argtypes = [u64pp, u64pp, ctypes.POINTER(ctypes.c_size_t),
+                               ctypes.c_size_t,
+                               ctypes.POINTER(ctypes.c_uint32),
+                               ctypes.POINTER(ctypes.c_uint32),
+                               u8p, ctypes.c_size_t]
+        self._u8p = u8p
+
+    # -- helpers -----------------------------------------------------------
+    @staticmethod
+    def _buf(b) -> tuple:
+        arr = np.frombuffer(b, np.uint8) if not isinstance(b, np.ndarray) else b
+        return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), arr.size
+
+    def crc32(self, data: bytes) -> int:
+        p, n = self._buf(data)
+        return int(self._crc32(p, n))
+
+    def hash64(self, data: bytes, seed: int = 0) -> int:
+        p, n = self._buf(data)
+        return int(self._hash64(p, n, seed))
+
+    def compress(self, data: bytes, codec: str = "zstd", level: int = 3) -> bytes:
+        p, n = self._buf(data)
+        if codec == "zstd":
+            cap = int(self._zstd_bound(n))
+            out = np.empty(cap, np.uint8)
+            r = _check(self._zstd_compress(p, n, out.ctypes.data_as(self._u8p), cap, level))
+        elif codec == "zlib":
+            cap = int(self._zlib_bound(n))
+            out = np.empty(cap, np.uint8)
+            r = _check(self._zlib_compress(p, n, out.ctypes.data_as(self._u8p), cap, level))
+        else:
+            raise ValueError(codec)
+        return out[:r].tobytes()
+
+    def decompress(self, data: bytes, raw_len: int, codec: str = "zstd") -> bytes:
+        p, n = self._buf(data)
+        out = np.empty(raw_len, np.uint8)
+        fn = self._zstd_decompress if codec == "zstd" else self._zlib_decompress
+        r = _check(fn(p, n, out.ctypes.data_as(self._u8p), raw_len))
+        return out[:r].tobytes()
+
+    def varint_encode(self, vals: np.ndarray) -> bytes:
+        vals = np.ascontiguousarray(vals, np.int64)
+        cap = vals.size * 10 + 16
+        out = np.empty(cap, np.uint8)
+        r = _check(self._venc(vals.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                              vals.size, out.ctypes.data_as(self._u8p), cap))
+        return out[:r].tobytes()
+
+    def varint_decode(self, data: bytes, n_elems: int) -> np.ndarray:
+        p, n = self._buf(data)
+        out = np.empty(n_elems, np.int64)
+        r = _check(self._vdec(p, n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                              n_elems))
+        if r != n_elems:
+            raise NativeError(f"decoded {r} elems, expected {n_elems}")
+        return out
+
+    PAGE_CODECS = {"none": 0, "zlib": 1, "zstd": 2}
+
+    def page_encode(self, raw: bytes, codec: str = "zstd", level: int = 3) -> bytes:
+        p, n = self._buf(raw)
+        cap = int(self._zstd_bound(n)) + 64
+        out = np.empty(cap, np.uint8)
+        r = _check(self._penc(p, n, out.ctypes.data_as(self._u8p), cap,
+                              self.PAGE_CODECS[codec], level))
+        return out[:r].tobytes()
+
+    def page_decode(self, page: bytes) -> bytes:
+        p, n = self._buf(page)
+        raw_len = _check(self._praw(p, n))
+        out = np.empty(max(raw_len, 1), np.uint8)
+        r = _check(self._pdec(p, n, out.ctypes.data_as(self._u8p), raw_len))
+        return out[:r].tobytes()
+
+    def kway_merge_u128(self, keys_hi: list[np.ndarray], keys_lo: list[np.ndarray]):
+        """Merge k sorted u128 streams -> (stream_idx, row_idx, dup_mask)."""
+        k = len(keys_hi)
+        his = [np.ascontiguousarray(h, np.uint64) for h in keys_hi]
+        los = [np.ascontiguousarray(l, np.uint64) for l in keys_lo]
+        lens = (ctypes.c_size_t * k)(*[h.size for h in his])
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        hp = (u64p * k)(*[h.ctypes.data_as(u64p) for h in his])
+        lp = (u64p * k)(*[l.ctypes.data_as(u64p) for l in los])
+        total = int(sum(h.size for h in his))
+        os_ = np.empty(total, np.uint32)
+        orow = np.empty(total, np.uint32)
+        odup = np.empty(total, np.uint8)
+        r = _check(self._kway(hp, lp, lens, k,
+                              os_.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+                              orow.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+                              odup.ctypes.data_as(self._u8p), total))
+        return os_[:r], orow[:r], odup[:r].astype(bool)
+
+
+def lib() -> _Binding | None:
+    """The process-wide binding, building the .so on first use."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is None and not _tried:
+            so = _build()
+            if so is not None:
+                try:
+                    _lib = _Binding(so)
+                except OSError:
+                    _lib = None
+            _tried = True
+    return _lib

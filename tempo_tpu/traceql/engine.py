@@ -124,6 +124,18 @@ class Engine:
         for trace in fetch(spec, start_s, end_s):
             ctx = EvalContext(trace)
             spans = ctx.all_spans()
+            if not spans:
+                continue
+            if start_s or end_s:
+                # exact trace-level window check: fetchers only prune at
+                # row-group/block granularity (false positives expected),
+                # and the live-ingester path doesn't prune at all
+                t_start = min(s.start_unix_nano for s in spans)
+                t_end = max(s.end_unix_nano for s in spans)
+                if start_s and t_end < start_s * 10**9:
+                    continue
+                if end_s and t_start > end_s * 10**9:
+                    continue
             matched = eval_spanset_expr(pipeline.stages[0], spans, ctx)
             ok = bool(matched)
             for stage in pipeline.stages[1:]:

@@ -317,3 +317,86 @@ class TestGenerator:
         assert reg.series_dropped == 1
         assert reg.remove_stale(now=102.0) == 2
         assert reg.active_series() == 0
+
+
+class TestReviewRegressions:
+    def test_servicegraph_long_names_stay_distinct(self):
+        """Edge sketch keys must hash the full (client, server) pair —
+        a >=15-char client name used to truncate the server out of the key."""
+        from tempo_tpu.modules.generator.registry import ManagedRegistry
+        from tempo_tpu.modules.generator.servicegraphs import ServiceGraphsProcessor
+
+        reg = ManagedRegistry("t")
+        p = ServiceGraphsProcessor(reg)
+        client_svc = "checkout-service-production"
+        for i in range(30):
+            tid = bytes([i]) * 16
+            c = tr.Span(trace_id=tid, span_id=b"\x01" * 8, name="call",
+                        kind=tr.KIND_CLIENT, duration_nano=10**7)
+            s = tr.Span(trace_id=tid, span_id=b"\x02" * 8, parent_span_id=b"\x01" * 8,
+                        name="serve", kind=tr.KIND_SERVER, duration_nano=10**6)
+            t1 = tr.Trace(trace_id=tid, batches=[({"service.name": client_svc}, [c])])
+            t2 = tr.Trace(trace_id=tid, batches=[({"service.name": f"downstream-{i}"}, [s])])
+            p.push(tr.traces_to_batch([t1]))
+            p.push(tr.traces_to_batch([t2]))
+        assert p.edges_emitted == 30
+        est = p.distinct_edges_estimate()
+        assert 20 <= est <= 40, est
+
+    def test_frontend_raises_on_partial_shard_failure(self, tmp_path):
+        """A failed shard must fail the query, not silently truncate it."""
+        app = make_app(tmp_path)
+        traces = synth.make_traces(5, seed=3)
+        app.push_traces(traces)
+        orig = app.querier.find_trace_by_id
+        calls = {"n": 0}
+
+        def flaky(tenant, trace_id, mode="all", **kw):
+            calls["n"] += 1
+            if mode == "blocks" and calls["n"] % 2 == 0:
+                raise OSError("backend read failed")
+            return orig(tenant, trace_id, mode=mode, **kw)
+
+        app.querier.find_trace_by_id = flaky
+        app.frontend.cfg.max_retries = 0
+        with pytest.raises(OSError):
+            app.frontend.find_trace_by_id("single-tenant", traces[0].trace_id)
+        app.shutdown()
+
+    def test_compactor_module_heartbeats_with_ring(self, tmp_path):
+        from tempo_tpu.db import DBConfig, TempoDB
+        from tempo_tpu.modules.compactor_module import CompactorModule
+
+        db = TempoDB(DBConfig(backend="local", backend_path=str(tmp_path / "b"),
+                              wal_path=str(tmp_path / "w")))
+        ring = Ring(MemoryKV(), heartbeat_timeout_s=0.2, replication_factor=1)
+        mod = CompactorModule(db, ring=ring, cycle_s=3600)
+        time.sleep(0.3)  # past the timeout: without heartbeats it'd be dead
+        ring.heartbeat(mod.instance_id)  # deterministic beat (loop period is 10s)
+        assert mod.owns("tenant-window-job")
+        mod.stop()
+        db.shutdown()
+
+    def test_filekv_concurrent_updates_do_not_lose_registrations(self, tmp_path):
+        import multiprocessing as mp
+
+        path = str(tmp_path / "ring.json")
+        ctx = mp.get_context("spawn")  # fork from threaded pytest can deadlock
+        procs = [ctx.Process(target=_register_in_ring, args=(path, i)) for i in range(6)]
+        [p.start() for p in procs]
+        [p.join() for p in procs]
+        assert all(p.exitcode == 0 for p in procs)
+        state = FileKV(path).get()
+        assert sorted(state) == [f"ing-{i}" for i in range(6)]
+
+    def test_heartbeat_reregisters_lost_instance(self):
+        kv = MemoryKV()
+        ring = Ring(kv)
+        ring.register("ing-0")
+        kv.update(lambda s: {})  # state wiped
+        ring.heartbeat("ing-0")
+        assert "ing-0" in kv.get()
+
+
+def _register_in_ring(path, i):  # top-level: spawn target must be picklable
+    Ring(FileKV(path)).register(f"ing-{i}")

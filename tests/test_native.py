@@ -1,0 +1,135 @@
+"""Native C++ codec library tests.
+
+The library must build in this image (g++ + system zlib/zstd are baked
+in), so these tests do NOT skip when the build fails — a broken native
+path is a real regression.
+"""
+
+import zlib
+
+import numpy as np
+import pytest
+
+from tempo_tpu import native
+from tempo_tpu.encoding.vtpu import codec
+
+
+@pytest.fixture(scope="module")
+def lib():
+    b = native.lib()
+    assert b is not None, "native codec library failed to build"
+    return b
+
+
+def test_crc32_matches_stdlib(lib):
+    data = b"span batch payload" * 100
+    assert lib.crc32(data) == zlib.crc32(data)
+
+
+def test_hash64_stable_and_seeded(lib):
+    d = b"trace-id-0123456789abcdef"
+    assert lib.hash64(d) == lib.hash64(d)
+    assert lib.hash64(d, 1) != lib.hash64(d, 2)
+    assert lib.hash64(d) != lib.hash64(d[:-1])
+
+
+@pytest.mark.parametrize("codec_name", ["zstd", "zlib"])
+def test_compress_roundtrip(lib, codec_name):
+    rng = np.random.default_rng(0)
+    # compressible: sorted small deltas
+    raw = np.sort(rng.integers(0, 1000, 50_000).astype(np.uint64)).tobytes()
+    comp = lib.compress(raw, codec_name)
+    assert len(comp) < len(raw)
+    assert lib.decompress(comp, len(raw), codec_name) == raw
+
+
+def test_decompress_corrupt_raises(lib):
+    comp = bytearray(lib.compress(b"x" * 1000, "zstd"))
+    comp[5] ^= 0xFF
+    with pytest.raises(native.NativeError):
+        lib.decompress(bytes(comp), 1000, "zstd")
+
+
+def test_varint_roundtrip(lib):
+    rng = np.random.default_rng(1)
+    vals = np.cumsum(rng.integers(-(2**20), 2**20, 10_000)).astype(np.int64)
+    vals[0] = -(2**62)  # extremes
+    vals[1] = 2**62
+    enc = lib.varint_encode(vals)
+    # delta+varint beats 8 bytes/elem on small deltas despite extremes
+    assert len(enc) < vals.size * 8
+    out = lib.varint_decode(enc, vals.size)
+    np.testing.assert_array_equal(out, vals)
+
+
+def test_varint_corrupt_raises(lib):
+    enc = bytearray(lib.varint_encode(np.arange(100, dtype=np.int64)))
+    with pytest.raises(native.NativeError):
+        lib.varint_decode(bytes(enc[:-1] + b"\xff"), 100)  # dangling continuation
+
+
+@pytest.mark.parametrize("codec_name", ["none", "zlib", "zstd"])
+def test_page_roundtrip(lib, codec_name):
+    raw = np.arange(10_000, dtype=np.uint32).tobytes()
+    page = lib.page_encode(raw, codec_name)
+    assert lib.page_decode(page) == raw
+
+
+def test_page_crc_detects_flip(lib):
+    raw = b"z" * 4096
+    page = bytearray(lib.page_encode(raw, "none"))
+    page[-1] ^= 0x01
+    with pytest.raises(native.NativeError):
+        lib.page_decode(bytes(page))
+
+
+def test_kway_merge_orders_and_flags_dups(lib):
+    # 3 sorted streams with a shared key
+    hi = [np.array([1, 5, 9], np.uint64), np.array([2, 5], np.uint64), np.array([0], np.uint64)]
+    lo = [np.array([0, 0, 0], np.uint64), np.array([0, 0], np.uint64), np.array([7], np.uint64)]
+    s, r, dup = lib.kway_merge_u128(hi, lo)
+    keys = [(int(hi[si][ri]), int(lo[si][ri])) for si, ri in zip(s, r)]
+    assert keys == sorted(keys)
+    assert dup.sum() == 1  # the second (5,0)
+    assert len(s) == 6
+
+
+def test_kway_merge_large_random(lib):
+    rng = np.random.default_rng(2)
+    streams_hi, streams_lo = [], []
+    for _ in range(5):
+        n = int(rng.integers(100, 500))
+        h = np.sort(rng.integers(0, 1000, n).astype(np.uint64))
+        streams_hi.append(h)
+        streams_lo.append(np.zeros(n, np.uint64))
+    s, r, dup = lib.kway_merge_u128(streams_hi, streams_lo)
+    merged = np.concatenate(streams_hi)
+    merged.sort()
+    got = np.array([streams_hi[si][ri] for si, ri in zip(s, r)])
+    np.testing.assert_array_equal(got, merged)
+    # dup flags mark every repeat of the previous key
+    np.testing.assert_array_equal(dup[1:], got[1:] == got[:-1])
+    assert not dup[0]
+
+
+# -- integration with the page codec ---------------------------------------
+
+
+def test_codec_zstd_roundtrip_via_native():
+    arr = np.arange(5000, dtype=np.int64).reshape(100, 50)
+    page, crc = codec.encode(arr, "zstd")
+    out = codec.decode(page, arr.dtype.str, arr.shape, "zstd", crc)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_codec_auto_resolves_to_zstd():
+    assert codec.best_codec() == "zstd"
+    assert codec.resolve_codec("auto") == "zstd"
+    assert codec.resolve_codec("zlib") == "zlib"
+
+
+def test_codec_crc_mismatch_raises():
+    arr = np.ones(100, np.uint32)
+    page, crc = codec.encode(arr, "zstd")
+    with pytest.raises(codec.CorruptPage):
+        codec.decode(page, arr.dtype.str, arr.shape, "zstd", crc ^ 1)

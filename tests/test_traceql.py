@@ -293,3 +293,19 @@ class TestStorageConformance:
         gm = {r.trace_id_hex: {s.span_id for s in r.spans} for r in got}
         wm = {r.trace_id_hex: {s.span_id for s in r.spans} for r in want}
         assert gm == wm, q
+
+
+class TestTimeWindow:
+    def test_engine_filters_out_of_window_traces(self):
+        """Fetchers prune only at row-group/block granularity; the engine
+        must re-check the window exactly (regression: live-ingester path
+        returned everything regardless of start/end)."""
+        t_old = synth.make_trace(1, base_time_ns=1_000 * 10**9)
+        t_new = synth.make_trace(2, base_time_ns=5_000 * 10**9)
+        fetch = lambda spec, s, e: [t_old, t_new]
+        got = execute("{ }", fetch, start_s=4_000, end_s=6_000, limit=0)
+        assert {r.trace_id_hex for r in got} == {t_new.trace_id.hex()}
+        got = execute("{ }", fetch, start_s=500, end_s=6_000, limit=0)
+        assert len(got) == 2
+        got = execute("{ }", fetch, limit=0)  # no window -> everything
+        assert len(got) == 2
