@@ -33,7 +33,7 @@ from tempo_tpu.encoding.common import (
 from tempo_tpu.encoding.vtpu import format as fmt
 from tempo_tpu.model.columnar import ATTR_COLUMNS, SPAN_COLUMNS, VT_STR, SpanBatch
 from tempo_tpu.model.trace import Trace, batch_to_traces
-from tempo_tpu.ops import bloom, scan
+from tempo_tpu.ops import bloom, pallas_kernels
 
 # columns needed to build TraceSearchMetadata for matching traces
 _META_COLS = ["trace_id", "parent_span_id", "start_unix_nano", "duration_nano", "name", "service"]
@@ -165,37 +165,28 @@ class VtpuBackendBlock:
         cols = self.read_columns(rg, sorted(phase1)) if phase1 else {}
         pad = self.cfg.bucket_for(n)
 
-        def dev(name):
-            arr = cols[name]
-            if arr.shape[0] < pad:
-                arr = np.concatenate([arr, np.zeros((pad - arr.shape[0],) + arr.shape[1:], arr.dtype)])
-            return jnp.asarray(arr)
-
         valid = np.zeros(pad, bool)
         valid[:n] = True
         mask = jnp.asarray(valid)
 
-        for col, codes in preds["span_eq"]:
-            cdev = dev(col)
-            if cdev.dtype == jnp.uint16:  # http_status exact value
-                mask = mask & scan.eq(cdev, int(codes[0]))
-            else:
-                mask = mask & scan.in_set(cdev, jnp.asarray(codes))
+        if preds["span_eq"]:
+            # ONE fused pallas pass over all stacked predicate columns
+            # (pad rows get the NO_MATCH sentinel inside the kernel prep,
+            # so they can never match)
+            mask = mask & pallas_kernels.in_set_scan(
+                [cols[col][:n] for col, _ in preds["span_eq"]],
+                [np.asarray(codes) for _, codes in preds["span_eq"]],
+                pad,
+            )
         if req.min_duration_ns or req.max_duration_ns:
-            # uint64 doesn't exist on device without x64; compare exactly as
-            # (seconds, nanos-within-second) uint32 pairs
-            dur = cols["duration_nano"]
-            ds = np.zeros(pad, np.uint32)
-            dn = np.zeros(pad, np.uint32)
-            ds[:n] = (dur // 10**9).astype(np.uint32)
-            dn[:n] = (dur % 10**9).astype(np.uint32)
-            ds, dn = jnp.asarray(ds), jnp.asarray(dn)
-            if req.min_duration_ns:
-                lo_s, lo_n = divmod(req.min_duration_ns, 10**9)
-                mask = mask & ((ds > lo_s) | ((ds == lo_s) & (dn >= lo_n)))
-            if req.max_duration_ns:
-                hi_s, hi_n = divmod(req.max_duration_ns, 10**9)
-                mask = mask & ((ds < hi_s) | ((ds == hi_s) & (dn <= hi_n)))
+            # uint64 doesn't exist on device without x64; the kernel
+            # compares as paired uint32 limbs
+            mask = mask & pallas_kernels.u64_range_scan(
+                cols["duration_nano"][:n],
+                req.min_duration_ns or 0,
+                req.max_duration_ns or (2**64 - 1),
+                pad,
+            )
 
         span_mask = np.array(mask[:n])  # copy: jax buffers are read-only
 

@@ -121,11 +121,14 @@ class Ring:
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.replication_factor = replication_factor
         self._unregistered: set[str] = set()
+        self._reg_params: dict[str, dict] = {}
 
     # -- membership (Lifecycler role) -----------------------------------
     def register(self, instance_id: str, addr: str = "", n_tokens: int = NUM_TOKENS,
                  seed: int | None = None) -> None:
         self._unregistered.discard(instance_id)
+        # stash params so lost-registration recovery replays them verbatim
+        self._reg_params[instance_id] = {"addr": addr, "n_tokens": n_tokens, "seed": seed}
         rng = random.Random(seed if seed is not None else instance_id)
         tokens = sorted(rng.randrange(0, 2**32) for _ in range(n_tokens))
 
@@ -154,7 +157,7 @@ class Ring:
         missing: list[str] = []
         self.kv.update(mutate)
         if missing and instance_id not in self._unregistered:
-            self.register(instance_id)
+            self.register(instance_id, **self._reg_params.get(instance_id, {}))
 
     def set_state(self, instance_id: str, st: str) -> None:
         def mutate(state):

@@ -120,6 +120,14 @@ class _Binding:
                                ctypes.POINTER(ctypes.c_uint32),
                                ctypes.POINTER(ctypes.c_uint32),
                                u8p, ctypes.c_size_t]
+        self._kway3 = lib.ttpu_kway_merge_u192
+        self._kway3.restype = ctypes.c_longlong
+        self._kway3.argtypes = [u64pp, u64pp, u64pp,
+                                ctypes.POINTER(ctypes.c_size_t),
+                                ctypes.c_size_t,
+                                ctypes.POINTER(ctypes.c_uint32),
+                                ctypes.POINTER(ctypes.c_uint32),
+                                u8p, ctypes.c_size_t]
         self._u8p = u8p
 
     # -- helpers -----------------------------------------------------------
@@ -208,6 +216,30 @@ class _Binding:
                               os_.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
                               orow.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
                               odup.ctypes.data_as(self._u8p), total))
+        return os_[:r], orow[:r], odup[:r].astype(bool)
+
+    def kway_merge_u192(self, keys_hi: list[np.ndarray], keys_mid: list[np.ndarray],
+                        keys_lo: list[np.ndarray]):
+        """Merge k sorted u192 streams (traceID hi/lo + spanID lanes) ->
+        (stream_idx, row_idx, dup_mask). Streams must each be sorted by
+        (hi, mid, lo); dup flags exact 192-bit repeats of the previous key."""
+        k = len(keys_hi)
+        his = [np.ascontiguousarray(h, np.uint64) for h in keys_hi]
+        mids = [np.ascontiguousarray(m, np.uint64) for m in keys_mid]
+        los = [np.ascontiguousarray(l, np.uint64) for l in keys_lo]
+        lens = (ctypes.c_size_t * k)(*[h.size for h in his])
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        hp = (u64p * k)(*[h.ctypes.data_as(u64p) for h in his])
+        mp = (u64p * k)(*[m.ctypes.data_as(u64p) for m in mids])
+        lp = (u64p * k)(*[l.ctypes.data_as(u64p) for l in los])
+        total = int(sum(h.size for h in his))
+        os_ = np.empty(total, np.uint32)
+        orow = np.empty(total, np.uint32)
+        odup = np.empty(total, np.uint8)
+        r = _check(self._kway3(hp, mp, lp, lens, k,
+                               os_.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+                               orow.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+                               odup.ctypes.data_as(self._u8p), total))
         return os_[:r], orow[:r], odup[:r].astype(bool)
 
 

@@ -237,37 +237,41 @@ long long ttpu_page_decode(const uint8_t* src, size_t n, uint8_t* dst,
 }
 
 // ---------------------------------------------------------------------------
-// k-way merge of sorted u128 id streams (two u64 lanes, little-endian
-// lane order: hi,lo). Host-side bookmark merge used by the compactor to
-// plan row pulls across input blocks; the device handles intra-batch
+// k-way merge of sorted id streams. Keys are u128 (two u64 lanes: hi,lo)
+// or u192 (three lanes: hi,mid,lo = traceID high/low + spanID). Host-side
+// bookmark merge used by the compactor to plan row pulls across input
+// blocks whose rows are already sorted; the device handles intra-batch
 // sort/dedupe, this handles the streaming cross-block order.
 // Emits (stream_idx u32, row_idx u32) pairs in global id order with
 // duplicates flagged via dup_mask bit.
 // ---------------------------------------------------------------------------
 
-long long ttpu_kway_merge_u128(const uint64_t* const* keys_hi,
-                               const uint64_t* const* keys_lo,
-                               const size_t* lens, size_t k,
-                               uint32_t* out_stream, uint32_t* out_row,
-                               uint8_t* out_dup, size_t cap) {
+static long long kway_merge_impl(const uint64_t* const* keys_hi,
+                                 const uint64_t* const* keys_mid,
+                                 const uint64_t* const* keys_lo,
+                                 const size_t* lens, size_t k,
+                                 uint32_t* out_stream, uint32_t* out_row,
+                                 uint8_t* out_dup, size_t cap) {
   if (k == 0) return 0;
   // simple loser-tree-free k-way scan: k is small (<=8 in compaction)
   size_t pos_buf[64];
   if (k > 64) return TTPU_ERR_ARG;
   memset(pos_buf, 0, sizeof(pos_buf));
   size_t emitted = 0;
-  uint64_t last_hi = 0, last_lo = 0;
+  uint64_t last_hi = 0, last_mid = 0, last_lo = 0;
   bool have_last = false;
   for (;;) {
     int best = -1;
-    uint64_t bh = 0, bl = 0;
+    uint64_t bh = 0, bm = 0, bl = 0;
     for (size_t i = 0; i < k; i++) {
       if (pos_buf[i] >= lens[i]) continue;
       uint64_t h = keys_hi[i][pos_buf[i]];
+      uint64_t m = keys_mid ? keys_mid[i][pos_buf[i]] : 0;
       uint64_t l = keys_lo[i][pos_buf[i]];
-      if (best < 0 || h < bh || (h == bh && l < bl)) {
+      if (best < 0 || h < bh || (h == bh && (m < bm || (m == bm && l < bl)))) {
         best = (int)i;
         bh = h;
+        bm = m;
         bl = l;
       }
     }
@@ -275,14 +279,35 @@ long long ttpu_kway_merge_u128(const uint64_t* const* keys_hi,
     if (emitted >= cap) return TTPU_ERR_CAP;
     out_stream[emitted] = (uint32_t)best;
     out_row[emitted] = (uint32_t)pos_buf[best];
-    out_dup[emitted] = (have_last && bh == last_hi && bl == last_lo) ? 1 : 0;
+    out_dup[emitted] =
+        (have_last && bh == last_hi && bm == last_mid && bl == last_lo) ? 1 : 0;
     last_hi = bh;
+    last_mid = bm;
     last_lo = bl;
     have_last = true;
     pos_buf[best]++;
     emitted++;
   }
   return (long long)emitted;
+}
+
+long long ttpu_kway_merge_u128(const uint64_t* const* keys_hi,
+                               const uint64_t* const* keys_lo,
+                               const size_t* lens, size_t k,
+                               uint32_t* out_stream, uint32_t* out_row,
+                               uint8_t* out_dup, size_t cap) {
+  return kway_merge_impl(keys_hi, nullptr, keys_lo, lens, k, out_stream,
+                         out_row, out_dup, cap);
+}
+
+long long ttpu_kway_merge_u192(const uint64_t* const* keys_hi,
+                               const uint64_t* const* keys_mid,
+                               const uint64_t* const* keys_lo,
+                               const size_t* lens, size_t k,
+                               uint32_t* out_stream, uint32_t* out_row,
+                               uint8_t* out_dup, size_t cap) {
+  return kway_merge_impl(keys_hi, keys_mid, keys_lo, lens, k, out_stream,
+                         out_row, out_dup, cap);
 }
 
 }  // extern "C"

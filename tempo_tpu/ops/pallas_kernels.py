@@ -1,0 +1,209 @@
+"""Pallas TPU kernels for the scan hot paths.
+
+The column predicate scan is the innermost loop of tag search and
+TraceQL fetch (reference hot loop: vparquet/block_search.go:95,297 and
+the parquetquery iterator tree). The jnp path in ops/scan.py leaves
+fusion to XLA; the pallas kernels here fuse an entire predicate set
+into ONE VMEM pass over the stacked column tile — no (N,) bool
+intermediates ever materialize in HBM, and the candidate code sets sit
+in SMEM next to the scalar unit.
+
+Kernels run compiled on TPU and in interpreter mode elsewhere (CPU
+tests), selected automatically; set TEMPO_TPU_NO_PALLAS=1 to force the
+jnp fallback everywhere.
+
+Geometry: column tiles are (C, TILE) with TILE=1024 — a multiple of the
+(8, 128) f32/u32 VPU tile, and the engine's minimum row-group pad
+(BlockConfig.min_device_bucket) — so blocks always divide evenly.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE = 1024
+NO_MATCH_CODE = np.uint32(0xFFFFFFFF)  # sentinel code: matches no dictionary entry
+
+
+def _use_pallas() -> bool:
+    return os.environ.get("TEMPO_TPU_NO_PALLAS", "") != "1"
+
+
+@functools.cache
+def _interpret() -> bool:
+    # compiled Mosaic kernels need a real TPU; everywhere else (CPU test
+    # meshes, the axon experimental platform fallback) use the interpreter
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+# ---------------------------------------------------------------------------
+# fused multi-column in-set scan
+# ---------------------------------------------------------------------------
+
+
+_SUBLANES = 8  # f32/u32 VPU sublane count; rows of the (8, n/8) layout
+
+
+def _in_set_kernel(codes_ref, cols_ref, out_ref):
+    """AND over predicates of (col_c in codes_c), one tile.
+
+    codes_ref: (C, S) uint32 in SMEM — candidate dictionary codes per
+    predicate column, padded with NO_MATCH_CODE.
+    cols_ref: (C, 8, t) uint32 in VMEM — rows pre-reshaped to fill all 8
+    VPU sublanes. out_ref: (8, t) uint32.
+    """
+    C, S = codes_ref.shape
+    mask = jnp.ones(out_ref.shape, jnp.uint32)
+    for c in range(C):
+        col = cols_ref[c]
+        hit = jnp.zeros_like(mask)
+        for s in range(S):
+            code = codes_ref[c, s]
+            hit = hit | (col == code).astype(jnp.uint32)
+        mask = mask & hit
+    out_ref[...] = mask
+
+
+def _tile_for(n8: int) -> int:
+    """Largest power-of-two lane tile <= 8Ki that divides n8 (= n/8, a
+    pow2 multiple of TILE/8). Small grids amortize per-program overhead;
+    VMEM stays bounded at C * 256 KiB per block."""
+    t = TILE // _SUBLANES
+    while t < (1 << 13) and n8 % (t << 1) == 0:
+        t <<= 1
+    return min(t, n8)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _in_set_call(cols_mat: jnp.ndarray, codes_mat: jnp.ndarray, interpret: bool):
+    """cols_mat: (C, N) uint32 -> (N,) uint32 match mask."""
+    C, N = cols_mat.shape
+    n8 = N // _SUBLANES
+    tile = _tile_for(n8)
+    out = pl.pallas_call(
+        _in_set_kernel,
+        out_shape=jax.ShapeDtypeStruct((_SUBLANES, n8), jnp.uint32),
+        grid=(n8 // tile,),
+        in_specs=[
+            pl.BlockSpec((C, codes_mat.shape[1]), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((C, _SUBLANES, tile), lambda i: (0, 0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((_SUBLANES, tile), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(codes_mat, cols_mat.reshape(C, _SUBLANES, n8))
+    return out.reshape(N)
+
+
+def in_set_scan(cols: list[np.ndarray], code_sets: list[np.ndarray], n_pad: int) -> jnp.ndarray:
+    """Fused AND-of-in-set scan: span row matches iff for every predicate
+    c, cols[c][row] is in code_sets[c].
+
+    cols: C arrays of (n,) integer dictionary codes (any uint dtype).
+    code_sets: C arrays of candidate codes (ragged; padded to one width).
+    n_pad: static padded row count (multiple of TILE — the engine's
+    bucket_for guarantees this).
+    Returns a (n_pad,) bool device array; rows past len(cols[c]) are False.
+    """
+    C = len(cols)
+    assert C == len(code_sets) and C > 0
+    assert n_pad % TILE == 0, n_pad
+    n = cols[0].shape[0]
+    mat = np.full((C, n_pad), NO_MATCH_CODE, dtype=np.uint32)  # pad rows never match
+    for c, col in enumerate(cols):
+        mat[c, :n] = col.astype(np.uint32)
+    s_pad = 1
+    while s_pad < max(cs.shape[0] for cs in code_sets):
+        s_pad <<= 1  # pow2 widths bound the jit cache
+    codes = np.full((C, s_pad), NO_MATCH_CODE, dtype=np.uint32)
+    for c, cs in enumerate(code_sets):
+        codes[c, : cs.shape[0]] = cs.astype(np.uint32)
+    if not _use_pallas():
+        from tempo_tpu.ops import scan  # one canonical in-set implementation
+
+        mask = jnp.ones(n_pad, bool)
+        dmat = jnp.asarray(mat)
+        for c in range(C):
+            mask = mask & scan.in_set(dmat[c], jnp.asarray(codes[c]))
+    else:
+        mask = _in_set_call(jnp.asarray(mat), jnp.asarray(codes), _interpret()).astype(bool)
+    if n < n_pad:
+        # pad rows hold NO_MATCH_CODE, but so does the code-set padding —
+        # they'd compare equal; mask pads explicitly
+        mask = mask & (jnp.arange(n_pad) < n)
+    return mask
+
+
+# ---------------------------------------------------------------------------
+# fused duration-range scan (uint64 as two uint32 lanes)
+# ---------------------------------------------------------------------------
+
+
+def _range_kernel(bounds_ref, hi_ref, lo_ref, out_ref):
+    """lo_bound <= (hi,lo) <= hi_bound on a 64-bit value split into two
+    uint32 lanes (no x64 on device). bounds_ref (SMEM): (4,) uint32 =
+    [min_hi, min_lo, max_hi, max_lo]."""
+    h = hi_ref[...]
+    l = lo_ref[...]
+    min_h, min_l, max_h, max_l = (bounds_ref[i] for i in range(4))
+    ge = (h > min_h) | ((h == min_h) & (l >= min_l))
+    le = (h < max_h) | ((h == max_h) & (l <= max_l))
+    out_ref[...] = (ge & le).astype(jnp.uint32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _range_call(hi: jnp.ndarray, lo: jnp.ndarray, bounds: jnp.ndarray, interpret: bool):
+    """hi/lo: (N,) uint32 limb arrays -> (N,) uint32 match mask."""
+    N = hi.shape[0]
+    n8 = N // _SUBLANES
+    tile = _tile_for(n8)
+    out = pl.pallas_call(
+        _range_kernel,
+        out_shape=jax.ShapeDtypeStruct((_SUBLANES, n8), jnp.uint32),
+        grid=(n8 // tile,),
+        in_specs=[
+            pl.BlockSpec((4,), lambda i: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((_SUBLANES, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_SUBLANES, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((_SUBLANES, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(bounds, hi.reshape(_SUBLANES, n8), lo.reshape(_SUBLANES, n8))
+    return out.reshape(N)
+
+
+def u64_range_scan(values: np.ndarray, lo_bound: int, hi_bound: int, n_pad: int) -> jnp.ndarray:
+    """lo_bound <= values <= hi_bound over uint64 values, evaluated on
+    device as paired uint32 limbs (duration predicates; reference:
+    parquetquery IntBetweenPredicate). Rows past len(values) are False."""
+    assert n_pad % TILE == 0
+    n = values.shape[0]
+    hi = np.zeros(n_pad, np.uint32)
+    lo = np.zeros(n_pad, np.uint32)
+    v = values.astype(np.uint64)
+    hi[:n] = (v >> np.uint64(32)).astype(np.uint32)
+    lo[:n] = (v & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    bounds = np.array(
+        [lo_bound >> 32, lo_bound & 0xFFFFFFFF, hi_bound >> 32, hi_bound & 0xFFFFFFFF],
+        dtype=np.uint32,
+    )
+    if not _use_pallas():
+        h, l = jnp.asarray(hi), jnp.asarray(lo)
+        ge = (h > bounds[0]) | ((h == bounds[0]) & (l >= bounds[1]))
+        le = (h < bounds[2]) | ((h == bounds[2]) & (l <= bounds[3]))
+        out = ge & le
+    else:
+        out = _range_call(jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(bounds), _interpret()).astype(bool)
+    if n < n_pad:
+        out = out & (jnp.arange(n_pad) < n)  # pad rows are (0,0): mask them
+    return out

@@ -1,0 +1,67 @@
+"""Pallas kernel tests: parity between the fused kernels (interpret mode
+on CPU), the jnp fallback, and a numpy oracle."""
+
+import numpy as np
+import pytest
+
+from tempo_tpu.ops import pallas_kernels as pk
+
+
+def _oracle_in_set(cols, code_sets, n_pad):
+    n = cols[0].shape[0]
+    out = np.zeros(n_pad, bool)
+    m = np.ones(n, bool)
+    for col, cs in zip(cols, code_sets):
+        m &= np.isin(col.astype(np.uint32), cs.astype(np.uint32))
+    out[:n] = m
+    return out
+
+class TestInSetScan:
+    @pytest.mark.parametrize("n,c,s", [(1024, 1, 1), (1024, 3, 4), (2048, 2, 7), (4096, 4, 1)])
+    def test_matches_oracle(self, n, c, s):
+        rng = np.random.default_rng(n + c + s)
+        cols = [rng.integers(0, 50, n).astype(np.uint32) for _ in range(c)]
+        sets_ = [rng.choice(50, size=s, replace=False).astype(np.uint32) for _ in range(c)]
+        got = np.asarray(pk.in_set_scan(cols, sets_, n))
+        np.testing.assert_array_equal(got, _oracle_in_set(cols, sets_, n))
+
+    def test_partial_fill_pads_false(self):
+        n, pad = 700, 1024
+        col = np.zeros(n, np.uint32)  # all match code 0
+        got = np.asarray(pk.in_set_scan([col], [np.array([0], np.uint32)], pad))
+        assert got[:n].all() and not got[n:].any()
+
+    def test_no_match_sentinel_set(self):
+        col = np.arange(1024, dtype=np.uint32)
+        got = np.asarray(pk.in_set_scan([col], [np.array([pk.NO_MATCH_CODE])], 1024))
+        assert not got.any()
+
+    def test_uint16_column(self):
+        col = np.full(1024, 500, np.uint16)  # http_status style
+        got = np.asarray(pk.in_set_scan([col], [np.array([500], np.uint32)], 1024))
+        assert got.all()
+
+    def test_fallback_matches_kernel(self, monkeypatch):
+        rng = np.random.default_rng(9)
+        cols = [rng.integers(0, 20, 2048).astype(np.uint32) for _ in range(2)]
+        sets_ = [np.array([3, 7], np.uint32), np.array([11], np.uint32)]
+        kern = np.asarray(pk.in_set_scan(cols, sets_, 2048))
+        monkeypatch.setenv("TEMPO_TPU_NO_PALLAS", "1")
+        fall = np.asarray(pk.in_set_scan(cols, sets_, 2048))
+        np.testing.assert_array_equal(kern, fall)
+
+
+class TestU64RangeScan:
+    @pytest.mark.parametrize("lo,hi", [(0, 2**64 - 1), (10**9, 5 * 10**9), (0, 10**6), (2**40, 2**63)])
+    def test_matches_oracle(self, lo, hi):
+        rng = np.random.default_rng(int(lo % 97))
+        v = rng.integers(0, 2**63, 2048).astype(np.uint64)
+        v[:10] = [0, 1, lo, max(lo - 1, 0), lo + 1, hi, hi - 1, min(hi + 1, 2**64 - 1), 2**32, 2**32 - 1]
+        got = np.asarray(pk.u64_range_scan(v, lo, hi, 2048))
+        want = (v >= lo) & (v <= hi)
+        np.testing.assert_array_equal(got, want)
+
+    def test_pad_rows_masked_even_when_zero_in_range(self):
+        v = np.full(100, 5, np.uint64)
+        got = np.asarray(pk.u64_range_scan(v, 0, 10, 1024))
+        assert got[:100].all() and not got[100:].any()
