@@ -1,0 +1,315 @@
+"""HTTP server: ingest receivers + query API + admin endpoints.
+
+Reference: the weaveworks server hosted by cmd/tempo/app (HTTP API paths
+pkg/api/http.go:54-62; admin endpoints /ready, /status/*, /metrics
+cmd/tempo/app/app.go:237-516) and the receiver ports collapsed onto one
+listener (the reference binds OTLP/Zipkin/Jaeger HTTP receivers on their
+conventional ports; here every protocol rides the main listener, keyed
+by path). stdlib ThreadingHTTPServer — no external HTTP framework in
+the image.
+
+Routes:
+  POST /v1/traces            OTLP http (protobuf or json)
+  POST /api/v2/spans         Zipkin v2 json
+  POST /api/traces           Jaeger thrift-binary batch
+  GET  /api/traces/{id}      trace by ID (OTLP json; protobuf if Accept'd)
+  GET  /api/search           tag search (tags=logfmt) or TraceQL (q=...)
+  GET  /api/search/tags      tag names in recent data
+  GET  /api/search/tag/{n}/values
+  GET  /api/echo             frontend liveness ("echo")
+  GET  /ready /metrics /status[/config|/services|/endpoints|/buildinfo]
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import traceback
+from dataclasses import asdict, is_dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+from tempo_tpu import receivers
+from tempo_tpu.api import params as api_params
+from tempo_tpu.api.params import BadRequest
+from tempo_tpu.modules.distributor import RateLimited
+from tempo_tpu.modules.ingester import MaxLiveTraces, TraceTooLarge
+from tempo_tpu.receivers import otlp
+from tempo_tpu.util import metrics
+
+VERSION = "0.1.0"
+
+log = logging.getLogger(__name__)
+
+_req_count = metrics.counter("tempo_request_duration_seconds_total", "HTTP requests by route/status")
+_req_hist = metrics.histogram("tempo_request_duration_seconds", "HTTP request latency")
+metrics.gauge("tempo_build_info", "Build information").set(1, version=VERSION)
+
+
+def _config_dict(cfg) -> dict:
+    if is_dataclass(cfg) and not isinstance(cfg, type):
+        return asdict(cfg)
+    if hasattr(cfg, "__dict__"):
+        return {k: _config_dict(v) if is_dataclass(v) else v for k, v in vars(cfg).items()}
+    return cfg
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "tempo-tpu/" + VERSION
+
+    # set by server factory
+    app = None
+    endpoints: list[str] = []
+
+    def log_message(self, fmt, *args):  # route through logging, not stderr
+        log.debug("http: " + fmt, *args)
+
+    # -- plumbing ------------------------------------------------------
+    def _send(self, code: int, body: bytes, content_type: str = "application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except BrokenPipeError:
+            pass
+
+    def _send_json(self, code: int, doc) -> None:
+        self._send(code, json.dumps(doc).encode())
+
+    def _send_error(self, code: int, msg: str) -> None:
+        self._send(code, (msg.rstrip("\n") + "\n").encode(), "text/plain; charset=utf-8")
+
+    def _org_id(self) -> str | None:
+        return self.headers.get("X-Scope-OrgID")
+
+    def _body(self) -> bytes:
+        if (self.headers.get("Transfer-Encoding") or "").lower() == "chunked":
+            body = bytearray()
+            while True:
+                size_line = self.rfile.readline(1024).strip()
+                size = int(size_line.split(b";")[0], 16)
+                if size == 0:
+                    self.rfile.readline(1024)  # trailing CRLF after last-chunk
+                    break
+                body += self.rfile.read(size)
+                self.rfile.read(2)  # chunk CRLF
+            body = bytes(body)
+        else:
+            n = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(n) if n else b""
+        return receivers.decompress_body(body, self.headers.get("Content-Encoding", ""))
+
+    # -- dispatch ------------------------------------------------------
+    def do_GET(self):  # noqa: N802
+        self._route("GET")
+
+    def do_POST(self):  # noqa: N802
+        self._route("POST")
+
+    def _route_template(self, path: str) -> str:
+        """Collapse id-bearing paths to templates so metric label
+        cardinality stays bounded."""
+        p = path.rstrip("/") or "/"
+        if p.startswith(api_params.PATH_TRACES + "/"):
+            return api_params.PATH_TRACES + "/{traceID}"
+        if p.startswith(api_params.PATH_SEARCH_TAG_VALUES + "/") and p.endswith("/values"):
+            return api_params.PATH_SEARCH_TAG_VALUES + "/{name}/values"
+        return p
+
+    def _route(self, method: str) -> None:
+        start = time.monotonic()
+        url = urlparse(self.path)
+        route = self._route_template(url.path)
+        code = 500
+        try:
+            code = self._handle(method, url)
+        except BadRequest as e:
+            code = 400
+            self._send_error(400, str(e))
+        except receivers.UnsupportedPayload as e:
+            code = 400
+            self._send_error(400, str(e))
+        except PermissionError as e:
+            code = 401
+            self._send_error(401, str(e))
+        except RateLimited as e:
+            code = 429
+            self._send_error(429, str(e))
+        except (TraceTooLarge, MaxLiveTraces) as e:
+            # reference maps resource-exhausted pushes to 429 (distributor
+            # push error translation)
+            code = 429
+            self._send_error(429, str(e))
+        except Exception:
+            code = 500
+            log.error("internal error on %s %s:\n%s", method, route, traceback.format_exc())
+            self._send_error(500, "internal error")
+        finally:
+            _req_count.inc(method=method, route=route, status_code=str(code))
+            _req_hist.observe(time.monotonic() - start, method=method, route=route)
+
+    def _handle(self, method: str, url) -> int:
+        path = url.path.rstrip("/") or "/"
+        qs = parse_qs(url.query)
+        app = self.app
+
+        # ingest
+        if method == "POST" and path in (
+            receivers.OTLP_HTTP_PATH,
+            receivers.ZIPKIN_PATH,
+            receivers.JAEGER_THRIFT_PATH,
+        ):
+            ct = self.headers.get("Content-Type", "")
+            try:
+                traces = receivers.decode_http(path, ct, self._body())
+            except (ValueError, OSError, TypeError, AttributeError, KeyError) as e:
+                # wire/thrift/json decode errors and shape-invalid JSON
+                raise BadRequest(f"malformed payload: {e}") from e
+            if traces:
+                app.push_traces(traces, org_id=self._org_id())
+            if path == receivers.OTLP_HTTP_PATH:
+                # OTLP/HTTP: response content type must match the request;
+                # empty ExportTraceServiceResponse = empty proto message
+                if "json" in ct:
+                    self._send(200, b"{}")
+                else:
+                    self._send(200, b"", "application/x-protobuf")
+                return 200
+            self._send(202, b"")
+            return 202
+
+        if method != "GET":
+            self._send_error(405, "method not allowed")
+            return 405
+
+        # query API
+        if path.startswith(api_params.PATH_TRACES + "/"):
+            return self._trace_by_id(path[len(api_params.PATH_TRACES) + 1 :], qs)
+        if path == api_params.PATH_SEARCH:
+            return self._search(qs)
+        if path == api_params.PATH_SEARCH_TAGS:
+            self._send_json(200, {"tagNames": app.search_tags(org_id=self._org_id())})
+            return 200
+        if path.startswith(api_params.PATH_SEARCH_TAG_VALUES + "/") and path.endswith("/values"):
+            tag = unquote(path[len(api_params.PATH_SEARCH_TAG_VALUES) + 1 : -len("/values")])
+            self._send_json(200, {"tagValues": app.search_tag_values(tag, org_id=self._org_id())})
+            return 200
+        if path == api_params.PATH_ECHO:
+            self._send(200, b"echo", "text/plain; charset=utf-8")
+            return 200
+
+        # admin
+        if path == "/ready":
+            self._send(200, b"ready", "text/plain; charset=utf-8")
+            return 200
+        if path == "/metrics":
+            self._send(200, metrics.expose().encode(), "text/plain; version=0.0.4")
+            return 200
+        if path == "/status" or path == "/status/endpoints":
+            self._send_json(200, {"endpoints": self.endpoints})
+            return 200
+        if path == "/status/buildinfo":
+            self._send_json(200, {"version": VERSION, "goVersion": "n/a", "pythonNative": True})
+            return 200
+        if path == "/status/config":
+            self._send_json(200, _config_dict(app.cfg))
+            return 200
+        if path == "/status/services":
+            self._send_json(200, app.service_states() if hasattr(app, "service_states") else {"app": "Running"})
+            return 200
+
+        self._send_error(404, "not found")
+        return 404
+
+    # -- query handlers ------------------------------------------------
+    def _trace_by_id(self, tail: str, qs: dict) -> int:
+        trace_id = api_params.parse_trace_id(tail)
+        trace = self.app.find_trace(trace_id, org_id=self._org_id())
+        if trace is None:
+            self._send_error(404, "trace not found")
+            return 404
+        accept = self.headers.get("Accept", "")
+        if "application/protobuf" in accept or "application/x-protobuf" in accept:
+            self._send(200, otlp.encode_traces_request([trace]), "application/protobuf")
+            return 200
+        self._send_json(200, otlp.encode_traces_json([trace]))
+        return 200
+
+    def _search(self, qs: dict) -> int:
+        req = api_params.parse_search_request(qs)
+        org = self._org_id()
+        if req.query:
+            hits = self.app.traceql(
+                req.query,
+                org_id=org,
+                start_s=req.start_seconds,
+                end_s=req.end_seconds,
+                limit=req.limit,
+            )
+            doc = {"traces": [t.to_dict() for t in hits], "metrics": {}}
+        else:
+            resp = self.app.search(req, org_id=org)
+            doc = {
+                "traces": [t.to_dict() for t in resp.traces],
+                "metrics": {
+                    "inspectedTraces": resp.inspected_traces,
+                    "inspectedBytes": str(resp.inspected_bytes),
+                    "inspectedBlocks": resp.inspected_blocks,
+                },
+            }
+        self._send_json(200, doc)
+        return 200
+
+
+_ENDPOINTS = [
+    "POST /v1/traces",
+    "POST /api/v2/spans",
+    "POST /api/traces",
+    "GET /api/traces/{traceID}",
+    "GET /api/search",
+    "GET /api/search/tags",
+    "GET /api/search/tag/{name}/values",
+    "GET /api/echo",
+    "GET /ready",
+    "GET /metrics",
+    "GET /status",
+    "GET /status/buildinfo",
+    "GET /status/config",
+    "GET /status/services",
+    "GET /status/endpoints",
+]
+
+
+class TempoServer:
+    """Owns the listener; one instance per process/role."""
+
+    def __init__(self, app, host: str = "127.0.0.1", port: int = 0):
+        handler = type("BoundHandler", (_Handler,), {"app": app, "endpoints": _ENDPOINTS})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "TempoServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever, name="tempo-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -117,6 +117,14 @@ class App:
     def traceql(self, query: str, org_id=None, **kw):
         return self.frontend.traceql(self.resolve_tenant(org_id), query, **kw)
 
+    def search_tags(self, org_id=None) -> list[str]:
+        """Reference: /api/search/tags is proxied by the frontend straight
+        to queriers (no sharding middleware)."""
+        return self.querier.search_tags(self.resolve_tenant(org_id))
+
+    def search_tag_values(self, tag: str, org_id=None) -> list[str]:
+        return self.querier.search_tag_values(self.resolve_tenant(org_id), tag)
+
     # -- lifecycle -------------------------------------------------------
     def start_loops(self):
         for ing in self.ingesters.values():

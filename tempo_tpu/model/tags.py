@@ -1,0 +1,76 @@
+"""Tag-name/value enumeration over span batches.
+
+Backs /api/search/tags and /api/search/tag/{name}/values (reference:
+the ingester's SearchTags/SearchTagValues over live + local data,
+modules/ingester/instance_search.go — in the snapshot era these
+endpoints query ingesters only). Columnar form: tag names are the
+dictionary-decoded attr_key codes plus the promoted well-known columns;
+values come from the matching column or attr rows.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tempo_tpu.model.columnar import VT_BOOL, VT_FLOAT, VT_INT, VT_STR, SpanBatch
+
+# promoted columns exposed as tags: tag name -> (column, kind)
+WELL_KNOWN_TAGS = {
+    "service.name": ("service", "dict"),
+    "name": ("name", "dict"),
+    "http.method": ("http_method", "dict"),
+    "http.url": ("http_url", "dict"),
+    "http.status_code": ("http_status", "int"),
+}
+
+
+def batch_tag_names(batch: SpanBatch) -> set[str]:
+    out: set[str] = set()
+    d = batch.dictionary
+    for tag, (col, kind) in WELL_KNOWN_TAGS.items():
+        vals = batch.cols[col]
+        if kind == "dict":
+            if any(d[int(c)] != "" for c in np.unique(vals)):
+                out.add(tag)
+        elif np.any(vals != 0):
+            out.add(tag)
+    for code in np.unique(batch.attrs["attr_key"]) if batch.num_attrs else []:
+        name = d[int(code)]
+        if name:
+            out.add(name)
+    return out
+
+
+def batch_tag_values(batch: SpanBatch, tag: str) -> set[str]:
+    d = batch.dictionary
+    out: set[str] = set()
+    wk = WELL_KNOWN_TAGS.get(tag)
+    if wk is not None:
+        col, kind = wk
+        for c in np.unique(batch.cols[col]):
+            if kind == "dict":
+                s = d[int(c)]
+                if s:
+                    out.add(s)
+            elif c != 0:
+                out.add(str(int(c)))
+        return out
+    code = d.get(tag)
+    if code is None or not batch.num_attrs:
+        return out
+    mask = batch.attrs["attr_key"] == code
+    vts = batch.attrs["attr_vtype"][mask]
+    strs = batch.attrs["attr_str"][mask]
+    nums = batch.attrs["attr_num"][mask]
+    for vt, sc, num in zip(vts, strs, nums):
+        if vt == VT_STR:
+            s = d[int(sc)]
+            if s:
+                out.add(s)
+        elif vt == VT_INT:
+            out.add(str(int(num)))
+        elif vt == VT_BOOL:
+            out.add("true" if num else "false")
+        elif vt == VT_FLOAT:
+            out.add(repr(float(num)))
+    return out

@@ -57,18 +57,23 @@ class Querier:
         return combine_traces(parts)
 
     # ------------------------------------------------------------------
+    def _live_batches(self, tenant: str):
+        """All not-yet-flushed columnar segments across ingesters; a
+        failing ingester is skipped, not fatal."""
+        out = []
+        for client in self.ingester_clients.values():
+            try:
+                out.extend(client.live_batches(tenant))
+            except Exception:
+                log.exception("ingester live_batches failed")
+        return out
+
     def search_recent(self, tenant: str, req: SearchRequest) -> SearchResponse:
         """Search not-yet-flushed data on all ingesters (reference:
         SearchRecent:326; ours scans live columnar segments)."""
         resp = SearchResponse()
-        for client in self.ingester_clients.values():
-            try:
-                batches = client.live_batches(tenant)
-            except Exception:
-                log.exception("ingester live_batches failed")
-                continue
-            for batch in batches:
-                resp.merge(_search_batch(batch, req), limit=req.limit)
+        for batch in self._live_batches(tenant):
+            resp.merge(_search_batch(batch, req), limit=req.limit)
         return resp
 
     def search_blocks(self, tenant: str, req: SearchRequest) -> SearchResponse:
@@ -81,6 +86,25 @@ class Querier:
 
     def search_block_job(self, tenant: str, block_id: str, req: SearchRequest) -> SearchResponse:
         return self.db.search_block(tenant, block_id, req)
+
+    def search_tags(self, tenant: str) -> list[str]:
+        """Tag names in not-yet-flushed ingester data (reference:
+        SearchTags fans to ingesters only in this snapshot,
+        modules/querier/querier.go + instance_search.go)."""
+        from tempo_tpu.model.tags import batch_tag_names
+
+        out: set[str] = set()
+        for batch in self._live_batches(tenant):
+            out |= batch_tag_names(batch)
+        return sorted(out)
+
+    def search_tag_values(self, tenant: str, tag: str) -> list[str]:
+        from tempo_tpu.model.tags import batch_tag_values
+
+        out: set[str] = set()
+        for batch in self._live_batches(tenant):
+            out |= batch_tag_values(batch, tag)
+        return sorted(out)
 
     def traceql(self, tenant: str, query: str, start_s=0, end_s=0, limit=20):
         results = self.db.traceql_search(tenant, query, start_s, end_s, limit)

@@ -1,0 +1,69 @@
+"""Multi-protocol span receivers.
+
+The reference hosts OTel collector receiver factories in-process —
+OTLP grpc/http, Jaeger variants, Zipkin — and adapts consumer.Traces to
+the distributor's PushTraces (modules/distributor/receiver/shim.go:94-133,
+ConsumeTraces:275). Here each protocol has a pure codec
+(otlp/zipkin/jaeger modules) and this shim maps an HTTP request
+(path + content-type + body) to decoded Traces for
+Distributor.push_traces. gRPC transports are out of scope for the image
+(no grpcio); the HTTP forms of each protocol are the supported carriers,
+matching the receiver set capability-wise.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import zlib
+
+from tempo_tpu.model.trace import Trace
+from tempo_tpu.receivers import jaeger, otlp, zipkin
+
+# paths, mirroring the default receiver endpoints
+OTLP_HTTP_PATH = "/v1/traces"
+ZIPKIN_PATH = "/api/v2/spans"
+JAEGER_THRIFT_PATH = "/api/traces"
+
+
+class UnsupportedPayload(ValueError):
+    pass
+
+
+def decompress_body(body: bytes, content_encoding: str) -> bytes:
+    enc = (content_encoding or "").lower()
+    if enc in ("", "identity"):
+        return body
+    if enc == "gzip":
+        return gzip.decompress(body)
+    if enc == "deflate":
+        return zlib.decompress(body)
+    raise UnsupportedPayload(f"unsupported content-encoding {content_encoding!r}")
+
+
+def decode_http(path: str, content_type: str, body: bytes) -> list[Trace]:
+    """Decode an ingest HTTP request into Traces, selecting the codec by
+    path + content type."""
+    ct = (content_type or "").split(";")[0].strip().lower()
+    if path == OTLP_HTTP_PATH:
+        if ct == "application/json":
+            return otlp.decode_traces_json(json.loads(body or b"{}"))
+        return otlp.decode_traces_request(body)
+    if path == ZIPKIN_PATH:
+        return zipkin.decode_spans_json(json.loads(body or b"[]"))
+    if path == JAEGER_THRIFT_PATH:
+        return jaeger.decode_batch(body)
+    raise UnsupportedPayload(f"no receiver for path {path!r}")
+
+
+__all__ = [
+    "OTLP_HTTP_PATH",
+    "ZIPKIN_PATH",
+    "JAEGER_THRIFT_PATH",
+    "UnsupportedPayload",
+    "decode_http",
+    "decompress_body",
+    "jaeger",
+    "otlp",
+    "zipkin",
+]

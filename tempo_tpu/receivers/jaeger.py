@@ -1,0 +1,238 @@
+"""Jaeger thrift-binary receiver codec.
+
+Decodes the jaeger.thrift `Batch` struct (the POST /api/traces
+payload accepted on the collector HTTP port, and the unit the reference's
+hosted jaegerreceiver consumes — modules/distributor/receiver/shim.go:117-128
+enables thrift_http among the Jaeger variants). Implements just enough
+of the Thrift binary protocol (strict or lax struct reading: field
+headers, the container types used by the schema) — no thrift runtime in
+the image.
+
+jaeger.thrift schema (public):
+  Batch   {1: Process process, 2: list<Span> spans}
+  Process {1: string serviceName, 2: list<Tag> tags}
+  Span    {1: i64 traceIdLow, 2: i64 traceIdHigh, 3: i64 spanId,
+           4: i64 parentSpanId, 5: string operationName,
+           6: list<SpanRef> references, 7: i32 flags, 8: i64 startTime,
+           9: i64 duration, 10: list<Tag> tags, 11: list<Log> logs}
+  Tag     {1: string key, 2: TagType vType, 3: string vStr,
+           4: double vDouble, 5: bool vBool, 6: i64 vLong, 7: binary vBinary}
+TagType: STRING=0 DOUBLE=1 BOOL=2 LONG=3 BINARY=4.
+Timestamps/durations are microseconds.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from tempo_tpu.model.trace import KIND_CLIENT, KIND_CONSUMER, KIND_PRODUCER, KIND_SERVER, Span, Trace
+
+# thrift binary TTypes
+T_STOP = 0
+T_BOOL = 2
+T_BYTE = 3
+T_DOUBLE = 4
+T_I16 = 6
+T_I32 = 8
+T_I64 = 10
+T_STRING = 11
+T_STRUCT = 12
+T_MAP = 13
+T_SET = 14
+T_LIST = 15
+
+
+class ThriftError(ValueError):
+    pass
+
+
+class _Reader:
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.buf = buf
+        self.pos = pos
+
+    def _take(self, n: int) -> bytes:
+        if self.pos + n > len(self.buf):
+            raise ThriftError("truncated thrift payload")
+        v = self.buf[self.pos : self.pos + n]
+        self.pos += n
+        return v
+
+    def i8(self) -> int:
+        return struct.unpack(">b", self._take(1))[0]
+
+    def i16(self) -> int:
+        return struct.unpack(">h", self._take(2))[0]
+
+    def i32(self) -> int:
+        return struct.unpack(">i", self._take(4))[0]
+
+    def i64(self) -> int:
+        return struct.unpack(">q", self._take(8))[0]
+
+    def double(self) -> float:
+        return struct.unpack(">d", self._take(8))[0]
+
+    def binary(self) -> bytes:
+        n = self.i32()
+        if n < 0:
+            raise ThriftError("negative string length")
+        return self._take(n)
+
+    def skip(self, ttype: int) -> None:
+        if ttype in (T_BOOL, T_BYTE):
+            self._take(1)
+        elif ttype == T_I16:
+            self._take(2)
+        elif ttype == T_I32:
+            self._take(4)
+        elif ttype in (T_I64, T_DOUBLE):
+            self._take(8)
+        elif ttype == T_STRING:
+            self.binary()
+        elif ttype == T_STRUCT:
+            while True:
+                ft = self.i8()
+                if ft == T_STOP:
+                    return
+                self.i16()
+                self.skip(ft)
+        elif ttype in (T_LIST, T_SET):
+            et = self.i8()
+            n = self.i32()
+            for _ in range(n):
+                self.skip(et)
+        elif ttype == T_MAP:
+            kt, vt = self.i8(), self.i8()
+            n = self.i32()
+            for _ in range(n):
+                self.skip(kt)
+                self.skip(vt)
+        else:
+            raise ThriftError(f"unknown ttype {ttype}")
+
+    def fields(self):
+        """Yield (field_id, ttype) for one struct; caller must consume
+        each field's value (or call skip)."""
+        while True:
+            ft = self.i8()
+            if ft == T_STOP:
+                return
+            fid = self.i16()
+            yield fid, ft
+
+    def list_header(self, want: int) -> int:
+        et = self.i8()
+        n = self.i32()
+        if et != want:
+            raise ThriftError(f"list elem type {et} != {want}")
+        if n < 0:
+            raise ThriftError("negative list length")
+        return n
+
+
+def _read_tag(r: _Reader):
+    key, vtype = "", 0
+    vstr, vdouble, vbool, vlong, vbin = "", 0.0, False, 0, b""
+    for fid, ft in r.fields():
+        if fid == 1 and ft == T_STRING:
+            key = r.binary().decode("utf-8", "replace")
+        elif fid == 2 and ft == T_I32:
+            vtype = r.i32()
+        elif fid == 3 and ft == T_STRING:
+            vstr = r.binary().decode("utf-8", "replace")
+        elif fid == 4 and ft == T_DOUBLE:
+            vdouble = r.double()
+        elif fid == 5 and ft == T_BOOL:
+            vbool = r.i8() != 0
+        elif fid == 6 and ft == T_I64:
+            vlong = r.i64()
+        elif fid == 7 and ft == T_STRING:
+            vbin = r.binary()
+        else:
+            r.skip(ft)
+    value = {0: vstr, 1: vdouble, 2: vbool, 3: vlong, 4: vbin.hex()}.get(vtype, vstr)
+    return key, value
+
+
+_SPAN_KIND_TAG = {
+    "client": KIND_CLIENT,
+    "server": KIND_SERVER,
+    "producer": KIND_PRODUCER,
+    "consumer": KIND_CONSUMER,
+}
+
+
+def _read_span(r: _Reader) -> Span:
+    tid_low = tid_high = span_id = parent = 0
+    name = ""
+    start_us = dur_us = 0
+    tags: dict = {}
+    for fid, ft in r.fields():
+        if fid == 1 and ft == T_I64:
+            tid_low = r.i64() & (2**64 - 1)
+        elif fid == 2 and ft == T_I64:
+            tid_high = r.i64() & (2**64 - 1)
+        elif fid == 3 and ft == T_I64:
+            span_id = r.i64() & (2**64 - 1)
+        elif fid == 4 and ft == T_I64:
+            parent = r.i64() & (2**64 - 1)
+        elif fid == 5 and ft == T_STRING:
+            name = r.binary().decode("utf-8", "replace")
+        elif fid == 8 and ft == T_I64:
+            start_us = r.i64()
+        elif fid == 9 and ft == T_I64:
+            dur_us = r.i64()
+        elif fid == 10 and ft == T_LIST:
+            for _ in range(r.list_header(T_STRUCT)):
+                k, v = _read_tag(r)
+                if k:
+                    tags[k] = v
+        else:
+            r.skip(ft)
+    kind = _SPAN_KIND_TAG.get(str(tags.pop("span.kind", "")).lower(), 0)
+    status = 2 if tags.get("error") in (True, "true") else 0
+    return Span(
+        trace_id=struct.pack(">QQ", tid_high, tid_low),
+        span_id=struct.pack(">Q", span_id),
+        parent_span_id=struct.pack(">Q", parent),
+        name=name,
+        start_unix_nano=start_us * 1000,
+        duration_nano=max(0, dur_us) * 1000,
+        kind=kind,
+        status_code=status,
+        attributes=tags,
+    )
+
+
+def decode_batch(buf: bytes) -> list[Trace]:
+    """Decode one thrift-binary jaeger Batch into Traces."""
+    r = _Reader(buf)
+    service = ""
+    process_tags: dict = {}
+    spans: list[Span] = []
+    for fid, ft in r.fields():
+        if fid == 1 and ft == T_STRUCT:  # Process
+            for pfid, pft in r.fields():
+                if pfid == 1 and pft == T_STRING:
+                    service = r.binary().decode("utf-8", "replace")
+                elif pfid == 2 and pft == T_LIST:
+                    for _ in range(r.list_header(T_STRUCT)):
+                        k, v = _read_tag(r)
+                        if k:
+                            process_tags[k] = v
+                else:
+                    r.skip(pft)
+        elif fid == 2 and ft == T_LIST:
+            for _ in range(r.list_header(T_STRUCT)):
+                spans.append(_read_span(r))
+        else:
+            r.skip(ft)
+    resource = {"service.name": service, **process_tags}
+    per_trace: dict[bytes, Trace] = {}
+    for s in spans:
+        t = per_trace.setdefault(s.trace_id, Trace(trace_id=s.trace_id))
+        if not t.batches:
+            t.batches.append((dict(resource), []))
+        t.batches[0][1].append(s)
+    return list(per_trace.values())

@@ -1,0 +1,71 @@
+"""Zipkin JSON v2 receiver codec.
+
+Translates Zipkin v2 span lists (the POST /api/v2/spans payload) into
+model Traces, following the same semantic mapping the collector's
+zipkinreceiver does for the reference
+(modules/distributor/receiver/shim.go:129 hosts it): localEndpoint →
+service.name, kind CLIENT/SERVER/PRODUCER/CONSUMER → OTLP kinds,
+timestamps/durations are microseconds, tags become string attributes.
+"""
+
+from __future__ import annotations
+
+import binascii
+
+from tempo_tpu.model.trace import (
+    KIND_CLIENT,
+    KIND_CONSUMER,
+    KIND_PRODUCER,
+    KIND_SERVER,
+    STATUS_ERROR,
+    Span,
+    Trace,
+)
+
+_KINDS = {
+    "CLIENT": KIND_CLIENT,
+    "SERVER": KIND_SERVER,
+    "PRODUCER": KIND_PRODUCER,
+    "CONSUMER": KIND_CONSUMER,
+}
+
+
+def _id_bytes(s: str, size: int) -> bytes:
+    s = (s or "").strip()
+    if len(s) % 2:
+        s = "0" + s
+    try:
+        raw = binascii.unhexlify(s)
+    except (binascii.Error, ValueError):
+        raw = b""
+    return raw.rjust(size, b"\x00")[-size:]
+
+
+def decode_spans_json(spans: list) -> list[Trace]:
+    per_trace: dict[bytes, dict[str, tuple[dict, list]]] = {}
+    for z in spans or []:
+        tid = _id_bytes(z.get("traceId", ""), 16)
+        service = ((z.get("localEndpoint") or {}).get("serviceName")) or ""
+        tags = {k: str(v) for k, v in (z.get("tags") or {}).items()}
+        status = STATUS_ERROR if "error" in tags else 0
+        span = Span(
+            trace_id=tid,
+            span_id=_id_bytes(z.get("id", ""), 8),
+            parent_span_id=_id_bytes(z.get("parentId", ""), 8),
+            name=z.get("name", ""),
+            start_unix_nano=int(z.get("timestamp", 0)) * 1000,
+            duration_nano=int(z.get("duration", 0)) * 1000,
+            kind=_KINDS.get(z.get("kind", ""), 0),
+            status_code=status,
+            attributes=tags,
+        )
+        buckets = per_trace.setdefault(tid, {})
+        if service not in buckets:
+            buckets[service] = ({"service.name": service}, [])
+        buckets[service][1].append(span)
+    out = []
+    for tid, buckets in per_trace.items():
+        t = Trace(trace_id=tid)
+        t.batches = list(buckets.values())
+        out.append(t)
+    return out

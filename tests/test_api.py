@@ -1,0 +1,274 @@
+"""HTTP API tests: param schema, end-to-end server round-trips over a
+real listener (ingest via each receiver protocol → query/search), admin
+endpoints, error mapping. Mirrors pkg/api tests + the e2e single-binary
+flow (integration/e2e/e2e_test.go:40-128) at unit scale."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tempo_tpu.api import params as api_params
+from tempo_tpu.api.params import BadRequest
+from tempo_tpu.api.server import TempoServer
+from tempo_tpu.app import App, AppConfig
+from tempo_tpu.db import DBConfig
+from tempo_tpu.model.synth import make_trace
+from tempo_tpu.receivers import otlp
+
+
+class TestParams:
+    def test_duration(self):
+        p = api_params.parse_duration_ns
+        assert p("1s") == 10**9
+        assert p("1.5s") == 1.5e9
+        assert p("2m") == 120 * 10**9
+        assert p("1h30m") == 5400 * 10**9
+        assert p("250ms") == 250 * 10**6
+        assert p("") == 0
+        with pytest.raises(BadRequest):
+            p("abc")
+        with pytest.raises(BadRequest):
+            p("1s2")
+
+    def test_logfmt_tags(self):
+        t = api_params.parse_logfmt_tags('service.name=api http.url="/x y" n=1')
+        assert t == {"service.name": "api", "http.url": "/x y", "n": "1"}
+        with pytest.raises(BadRequest):
+            api_params.parse_logfmt_tags("noequals")
+
+    def test_search_request(self):
+        req = api_params.parse_search_request(
+            {"tags": ["name=GET"], "minDuration": ["1ms"], "start": ["10"], "end": ["20"], "limit": ["5"]}
+        )
+        assert req.tags == {"name": "GET"}
+        assert req.min_duration_ns == 10**6
+        assert (req.start_seconds, req.end_seconds, req.limit) == (10, 20, 5)
+        with pytest.raises(BadRequest):
+            api_params.parse_search_request({"start": ["20"], "end": ["10"]})
+        with pytest.raises(BadRequest):
+            api_params.parse_search_request({"limit": ["0"]})
+        with pytest.raises(BadRequest):
+            api_params.parse_search_request({"minDuration": ["2s"], "maxDuration": ["1s"]})
+
+    def test_block_request_round_trip(self):
+        req = api_params.parse_search_block_request(
+            {"blockID": ["abcd"], "startRowGroup": ["2"], "rowGroups": ["3"], "tags": ["a=b"], "version": ["vtpu1"]}
+        )
+        qs = api_params.build_search_block_params(req)
+        back = api_params.parse_search_block_request({k: [v] for k, v in qs.items()})
+        assert back.block_id == "abcd"
+        assert back.start_row_group == 2
+        assert back.row_groups == 3
+        assert back.search.tags == {"a": "b"}
+        assert back.version == "vtpu1"
+        with pytest.raises(BadRequest):
+            api_params.parse_search_block_request({})
+
+    def test_trace_id(self):
+        assert api_params.parse_trace_id("0a") == b"\x00" * 15 + b"\x0a"
+        assert api_params.parse_trace_id("ff" * 16) == b"\xff" * 16
+        for bad in ("", "zz", "0" * 34):
+            with pytest.raises(BadRequest):
+                api_params.parse_trace_id(bad)
+
+
+@pytest.fixture()
+def served_app(tmp_path):
+    app = App(
+        AppConfig(
+            db=DBConfig(backend="local", backend_path=str(tmp_path / "blocks"), wal_path=str(tmp_path / "wal"))
+        )
+    )
+    server = TempoServer(app).start()
+    yield app, server
+    server.stop()
+    app.shutdown()
+
+
+def _get(url, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, r.read(), dict(r.headers)
+
+
+def _post(url, body, content_type, headers=None):
+    h = {"Content-Type": content_type, **(headers or {})}
+    req = urllib.request.Request(url, data=body, headers=h, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, r.read()
+
+
+class TestServer:
+    def test_otlp_ingest_query_search(self, served_app):
+        app, server = served_app
+        trace = make_trace(seed=3, n_spans=6)
+        status, _ = _post(
+            f"{server.url}/v1/traces", otlp.encode_traces_request([trace]), "application/x-protobuf"
+        )
+        assert status == 200
+
+        # trace-by-id straight from live ingester data
+        hexid = trace.trace_id.hex()
+        status, body, _ = _get(f"{server.url}/api/traces/{hexid}")
+        assert status == 200
+        doc = json.loads(body)
+        got_spans = [s for rs in doc["resourceSpans"] for ss in rs["scopeSpans"] for s in ss["spans"]]
+        assert len(got_spans) == trace.span_count()
+        assert {s["traceId"] for s in got_spans} == {hexid}
+
+        # protobuf accept
+        status, body, headers = _get(
+            f"{server.url}/api/traces/{hexid}", headers={"Accept": "application/protobuf"}
+        )
+        assert status == 200
+        assert headers["Content-Type"] == "application/protobuf"
+        back = otlp.decode_traces_request(body)
+        assert back[0].trace_id == trace.trace_id
+
+        # tag search over recent data
+        svc = trace.batches[0][0]["service.name"]
+        status, body, _ = _get(f"{server.url}/api/search?tags=service.name%3D{svc}")
+        assert status == 200
+        hits = json.loads(body)["traces"]
+        assert hexid in {t["traceID"] for t in hits}
+
+        # tags + tag values
+        status, body, _ = _get(f"{server.url}/api/search/tags")
+        names = json.loads(body)["tagNames"]
+        assert "service.name" in names
+        status, body, _ = _get(f"{server.url}/api/search/tag/service.name/values")
+        assert svc in json.loads(body)["tagValues"]
+
+    def test_zipkin_and_jaeger_paths(self, served_app):
+        app, server = served_app
+        z = [
+            {
+                "traceId": "ab" * 16,
+                "id": "cd" * 8,
+                "name": "zk",
+                "timestamp": 1_000_000,
+                "duration": 1000,
+                "localEndpoint": {"serviceName": "zipkin-svc"},
+            }
+        ]
+        status, _ = _post(f"{server.url}/api/v2/spans", json.dumps(z).encode(), "application/json")
+        assert status == 202
+        status, body, _ = _get(f"{server.url}/api/traces/{'ab' * 16}")
+        assert status == 200
+
+    def test_admin_endpoints(self, served_app):
+        app, server = served_app
+        assert _get(f"{server.url}/api/echo")[1] == b"echo"
+        assert _get(f"{server.url}/ready")[1] == b"ready"
+        status, body, _ = _get(f"{server.url}/metrics")
+        assert status == 200
+        assert b"tempo_build_info" in body
+        assert b"tempo_request_duration_seconds_bucket" in body
+        status, body, _ = _get(f"{server.url}/status/config")
+        assert json.loads(body)["target"] == "all"
+        status, body, _ = _get(f"{server.url}/status/endpoints")
+        assert "GET /api/search" in json.loads(body)["endpoints"]
+        status, body, _ = _get(f"{server.url}/status/buildinfo")
+        assert "version" in json.loads(body)
+
+    def test_errors(self, served_app):
+        app, server = served_app
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"{server.url}/api/traces/zz")
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"{server.url}/api/traces/{'0' * 32}")
+        assert e.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"{server.url}/nope")
+        assert e.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"{server.url}/api/search?limit=0")
+        assert e.value.code == 400
+
+    def test_chunked_ingest(self, served_app):
+        import http.client
+
+        app, server = served_app
+        trace = make_trace(seed=11, n_spans=3)
+        body = otlp.encode_traces_request([trace])
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=30)
+        try:
+            conn.putrequest("POST", "/v1/traces")
+            conn.putheader("Content-Type", "application/x-protobuf")
+            conn.putheader("Transfer-Encoding", "chunked")
+            conn.endheaders()
+            for i in range(0, len(body), 100):
+                chunk = body[i : i + 100]
+                conn.send(("%x\r\n" % len(chunk)).encode() + chunk + b"\r\n")
+            conn.send(b"0\r\n\r\n")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            resp.read()
+        finally:
+            conn.close()
+        status, _, _ = _get(f"{server.url}/api/traces/{trace.trace_id.hex()}")
+        assert status == 200
+
+    def test_multitenancy_requires_org(self, tmp_path):
+        app = App(
+            AppConfig(
+                multitenancy_enabled=True,
+                db=DBConfig(
+                    backend="local", backend_path=str(tmp_path / "blocks"), wal_path=str(tmp_path / "wal")
+                ),
+            )
+        )
+        server = TempoServer(app).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(f"{server.url}/api/search")
+            assert e.value.code == 401
+            trace = make_trace(seed=1, n_spans=2)
+            status, _ = _post(
+                f"{server.url}/v1/traces",
+                otlp.encode_traces_request([trace]),
+                "application/x-protobuf",
+                headers={"X-Scope-OrgID": "team-a"},
+            )
+            assert status == 200
+            status, body, _ = _get(
+                f"{server.url}/api/traces/{trace.trace_id.hex()}", headers={"X-Scope-OrgID": "team-a"}
+            )
+            assert status == 200
+            # other tenant can't see it
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(f"{server.url}/api/traces/{trace.trace_id.hex()}", headers={"X-Scope-OrgID": "team-b"})
+            assert e.value.code == 404
+        finally:
+            server.stop()
+            app.shutdown()
+
+    def test_flushed_block_visible_via_search(self, served_app):
+        app, server = served_app
+        traces = [make_trace(seed=i, n_spans=4) for i in range(4)]
+        status, _ = _post(
+            f"{server.url}/v1/traces", otlp.encode_traces_request(traces), "application/x-protobuf"
+        )
+        assert status == 200
+        app.sweep_all(immediate=True)  # cut + complete + flush to backend
+        app.db.poll_now()
+        hexid = traces[0].trace_id.hex()
+        status, body, _ = _get(f"{server.url}/api/traces/{hexid}")
+        assert status == 200
+        status, body, _ = _get(f"{server.url}/api/search?limit=10")
+        assert {t["traceID"] for t in json.loads(body)["traces"]} >= {hexid}
+
+
+class TestTraceQLOverHTTP:
+    def test_q_param(self, served_app):
+        app, server = served_app
+        trace = make_trace(seed=9, n_spans=5)
+        _post(f"{server.url}/v1/traces", otlp.encode_traces_request([trace]), "application/x-protobuf")
+        svc = trace.batches[0][0]["service.name"]
+        q = urllib.parse.quote(f'{{ resource.service.name = "{svc}" }}')
+        status, body, _ = _get(f"{server.url}/api/search?q={q}")
+        assert status == 200
+        assert trace.trace_id.hex() in {t["traceID"] for t in json.loads(body)["traces"]}

@@ -1,0 +1,58 @@
+"""Metrics registry: exposition format, label handling, histogram
+cumulative buckets (reference: promauto usage across modules,
+SURVEY.md section 5.5)."""
+
+from tempo_tpu.util.metrics import Registry
+
+
+def test_counter_and_labels():
+    r = Registry()
+    c = r.counter("tempo_things_total", "things")
+    c.inc()
+    c.inc(2, tenant="a")
+    assert c.value() == 1
+    assert c.value(tenant="a") == 2
+    text = r.expose()
+    assert "# TYPE tempo_things_total counter" in text
+    assert 'tempo_things_total{tenant="a"} 2' in text
+    assert "tempo_things_total 1" in text.splitlines()
+
+
+def test_gauge():
+    r = Registry()
+    g = r.gauge("tempo_live", "live")
+    g.set(5, role="ingester")
+    g.dec(2, role="ingester")
+    assert g.value(role="ingester") == 3
+    assert 'tempo_live{role="ingester"} 3' in r.expose()
+
+
+def test_histogram_cumulative():
+    r = Registry()
+    h = r.histogram("tempo_lat", "latency", buckets=(0.1, 1, 10))
+    for v in (0.05, 0.5, 0.5, 5, 50):
+        h.observe(v)
+    text = r.expose()
+    assert 'tempo_lat_bucket{le="0.1"} 1' in text
+    assert 'tempo_lat_bucket{le="1"} 3' in text
+    assert 'tempo_lat_bucket{le="10"} 4' in text
+    assert 'tempo_lat_bucket{le="+Inf"} 5' in text
+    assert "tempo_lat_count 5" in text
+    assert h.count() == 5
+    assert abs(h.sum() - 56.05) < 1e-9
+
+
+def test_same_name_same_metric():
+    r = Registry()
+    assert r.counter("x") is r.counter("x")
+    try:
+        r.gauge("x")
+        raise AssertionError("expected type conflict")
+    except ValueError:
+        pass
+
+
+def test_label_escaping():
+    r = Registry()
+    r.counter("c").inc(q='say "hi"\nnow')
+    assert 'q="say \\"hi\\"\\nnow"' in r.expose()
