@@ -1,0 +1,357 @@
+"""tempo-cli equivalent: offline block tooling against a backend.
+
+Reference: cmd/tempo-cli (kong command tree main.go:38-78; per-command
+files cmd-list-*.go, cmd-view-*.go, cmd-query.go, cmd-gen-*.go):
+list tenants/blocks/compaction summary, view block meta + index +
+columns, query trace-by-id and search straight against the backend
+(no running cluster), regenerate bloom filters, dump the tenant index.
+
+Usage: python -m tempo_tpu.cli --path /data/blocks <command> ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def _backend(args):
+    from tempo_tpu.backend.base import TypedBackend
+    from tempo_tpu.backend.local import LocalBackend
+
+    if args.backend != "local":
+        raise SystemExit(f"unsupported backend {args.backend!r} for CLI (local only)")
+    return TypedBackend(LocalBackend(args.path))
+
+
+def _open_block(backend, tenant: str, block_id: str):
+    from tempo_tpu.encoding.vtpu.block import VtpuBackendBlock
+
+    meta = backend.block_meta(tenant, block_id)
+    return VtpuBackendBlock(meta, backend)
+
+
+def _fmt_ts(sec: int) -> str:
+    import datetime
+
+    if not sec:
+        return "-"
+    return datetime.datetime.fromtimestamp(sec, datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def _print_table(rows: list[list], headers: list[str]) -> None:
+    rows = [headers] + [[str(c) for c in r] for r in rows]
+    widths = [max(len(r[i]) for r in rows) for i in range(len(headers))]
+    for i, r in enumerate(rows):
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+        if i == 0:
+            print("  ".join("-" * w for w in widths))
+
+
+# -- list ------------------------------------------------------------------
+
+
+def cmd_list_tenants(args) -> int:
+    be = _backend(args)
+    for t in sorted(be.tenants()):
+        print(t)
+    return 0
+
+
+def _tenant_metas(be, tenant):
+    from tempo_tpu.db.blocklist import scan_tenant
+
+    return scan_tenant(be, tenant)
+
+
+def cmd_list_blocks(args) -> int:
+    be = _backend(args)
+    metas, compacted = _tenant_metas(be, args.tenant)
+    rows = []
+    for m in sorted(metas, key=lambda m: m.start_time):
+        rows.append(
+            [
+                m.block_id,
+                m.compaction_level,
+                m.total_objects,
+                m.total_spans,
+                f"{m.size_bytes:,}",
+                _fmt_ts(m.start_time),
+                _fmt_ts(m.end_time),
+            ]
+        )
+    _print_table(rows, ["block", "lvl", "traces", "spans", "bytes", "start", "end"])
+    if args.include_compacted and compacted:
+        print(f"\ncompacted ({len(compacted)}):")
+        for c in sorted(compacted, key=lambda c: c.compacted_time):
+            print(f"  {c.meta.block_id}  compacted_at={_fmt_ts(int(c.compacted_time))}")
+    return 0
+
+
+def cmd_list_compaction_summary(args) -> int:
+    be = _backend(args)
+    metas, _ = _tenant_metas(be, args.tenant)
+    by_level: dict[int, list] = {}
+    for m in metas:
+        by_level.setdefault(m.compaction_level, []).append(m)
+    rows = []
+    for lvl in sorted(by_level):
+        ms = by_level[lvl]
+        rows.append(
+            [
+                lvl,
+                len(ms),
+                sum(m.total_objects for m in ms),
+                f"{sum(m.size_bytes for m in ms):,}",
+                _fmt_ts(min(m.start_time for m in ms)),
+                _fmt_ts(max(m.end_time for m in ms)),
+            ]
+        )
+    _print_table(rows, ["lvl", "blocks", "traces", "bytes", "oldest", "newest"])
+    return 0
+
+
+def cmd_list_index(args) -> int:
+    """Dump the tenant index (reference: cmd-list-index.go)."""
+    from tempo_tpu.backend.tenantindex import read_tenant_index
+
+    be = _backend(args)
+    idx = read_tenant_index(be.raw, args.tenant)
+    doc = {
+        "created_at": idx.created_at,
+        "blocks": [m.block_id for m in idx.metas],
+        "compacted": [c.meta.block_id for c in idx.compacted],
+    }
+    print(json.dumps(doc, indent=2))
+    return 0
+
+
+# -- view ------------------------------------------------------------------
+
+
+def cmd_view_block(args) -> int:
+    be = _backend(args)
+    blk = _open_block(be, args.tenant, args.block)
+    m = blk.meta
+    print(json.dumps(json.loads(m.to_json()), indent=2))
+    idx = blk.index()
+    print(f"\nrow groups: {len(idx.row_groups)}")
+    rows = [
+        [i, rg.n_spans, rg.n_traces, rg.min_id[:8] + "..", rg.max_id[:8] + "..", rg.start_s, rg.end_s]
+        for i, rg in enumerate(idx.row_groups)
+    ]
+    _print_table(rows, ["rg", "spans", "traces", "min_id", "max_id", "start_s", "end_s"])
+    return 0
+
+
+def cmd_view_columns(args) -> int:
+    """Per-column page sizes across row groups (reference:
+    cmd-view-schema/parquet column dumps)."""
+    be = _backend(args)
+    blk = _open_block(be, args.tenant, args.block)
+    totals: dict[str, list[int]] = {}
+    for rg in blk.index().row_groups:
+        for name, pm in rg.pages.items():
+            t = totals.setdefault(name, [0, 0])
+            t[0] += pm.length
+            t[1] += int(np.prod(pm.shape)) * np.dtype(pm.dtype).itemsize
+    rows = [
+        [name, f"{stored:,}", f"{raw:,}", f"{stored / max(raw, 1):.3f}"]
+        for name, (stored, raw) in sorted(totals.items(), key=lambda kv: -kv[1][0])
+    ]
+    _print_table(rows, ["column", "stored", "raw", "ratio"])
+    d = blk.dictionary()
+    print(f"\ndictionary: {len(d)} entries")
+    return 0
+
+
+# -- query -----------------------------------------------------------------
+
+
+def cmd_query_trace(args) -> int:
+    from tempo_tpu.api.params import parse_trace_id
+    from tempo_tpu.receivers import otlp
+
+    be = _backend(args)
+    tid = parse_trace_id(args.trace_id)
+    metas, _ = _tenant_metas(be, args.tenant)
+    from tempo_tpu.encoding.vtpu.block import VtpuBackendBlock
+
+    hits = []
+    for m in metas:
+        blk = VtpuBackendBlock(m, be)
+        t = blk.find_trace_by_id(tid)
+        if t is not None:
+            hits.append(t)
+            print(f"found in block {m.block_id}", file=sys.stderr)
+    if not hits:
+        print("trace not found", file=sys.stderr)
+        return 1
+    from tempo_tpu.model.trace import combine_traces
+
+    print(json.dumps(otlp.encode_traces_json([combine_traces(hits)]), indent=2))
+    return 0
+
+
+def cmd_query_search(args) -> int:
+    from tempo_tpu.api.params import parse_logfmt_tags
+    from tempo_tpu.encoding.common import SearchRequest
+    from tempo_tpu.encoding.vtpu.block import VtpuBackendBlock
+
+    be = _backend(args)
+    req = SearchRequest(tags=parse_logfmt_tags(args.tags or ""), limit=args.limit, query=args.q or "")
+    metas, _ = _tenant_metas(be, args.tenant)
+    results = []
+    if req.query:
+        from tempo_tpu.traceql import execute
+
+        for m in metas:
+            blk = VtpuBackendBlock(m, be)
+
+            def fetcher(spec, s, e, _blk=blk):
+                return _blk.fetch_candidates(spec, s, e)
+
+            results.extend(execute(req.query, fetcher, limit=req.limit))
+    else:
+        for m in metas:
+            blk = VtpuBackendBlock(m, be)
+            results.extend(blk.search(req).traces)
+    seen = set()
+    for r in sorted(results, key=lambda r: -r.start_time_unix_nano):
+        if r.trace_id_hex in seen:
+            continue
+        seen.add(r.trace_id_hex)
+        print(json.dumps(r.to_dict()))
+        if req.limit and len(seen) >= req.limit:
+            break
+    return 0
+
+
+# -- gen -------------------------------------------------------------------
+
+
+def cmd_gen_bloom(args) -> int:
+    """Rebuild bloom shards from the block's trace IDs (reference:
+    cmd-gen-bloom.go)."""
+    import jax.numpy as jnp
+
+    from tempo_tpu.backend.base import bloom_name
+    from tempo_tpu.ops import bloom as bloom_ops
+
+    be = _backend(args)
+    blk = _open_block(be, args.tenant, args.block)
+    m = blk.meta
+    ids = []
+    for rg in blk.index().row_groups:
+        cols = blk.read_columns(rg, ["trace_id"])
+        ids.append(cols["trace_id"])
+    tids = np.unique(np.concatenate(ids), axis=0)
+    plan = blk.bloom_plan()
+    words = np.asarray(bloom_ops.build(jnp.asarray(tids), plan))
+    for shard in range(plan.n_shards):
+        be.write_named(m, bloom_name(shard), bloom_ops.shard_to_bytes(words[shard]))
+    print(f"rebuilt {plan.n_shards} bloom shard(s) from {len(tids)} trace ids")
+    return 0
+
+
+def cmd_gen_index(args) -> int:
+    """Re-write the tenant index from a bucket scan (reference:
+    cmd-gen-index.go)."""
+    import time
+
+    from tempo_tpu.backend.tenantindex import TenantIndex, write_tenant_index
+
+    be = _backend(args)
+    metas, compacted = _tenant_metas(be, args.tenant)
+    write_tenant_index(be.raw, args.tenant, TenantIndex(created_at=time.time(), metas=metas, compacted=compacted))
+    print(f"wrote tenant index: {len(metas)} blocks, {len(compacted)} compacted")
+    return 0
+
+
+# -- wiring ----------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tempo-tpu-cli", description=__doc__)
+    p.add_argument("--backend", default="local")
+    p.add_argument("--path", required=True, help="backend root (local dir)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    lst = sub.add_parser("list", help="list tenants/blocks/summary/index").add_subparsers(
+        dest="what", required=True
+    )
+    lst.add_parser("tenants").set_defaults(fn=cmd_list_tenants)
+    lb = lst.add_parser("blocks")
+    lb.add_argument("tenant")
+    lb.add_argument("--include-compacted", action="store_true")
+    lb.set_defaults(fn=cmd_list_blocks)
+    lc = lst.add_parser("compaction-summary")
+    lc.add_argument("tenant")
+    lc.set_defaults(fn=cmd_list_compaction_summary)
+    li = lst.add_parser("index")
+    li.add_argument("tenant")
+    li.set_defaults(fn=cmd_list_index)
+
+    view = sub.add_parser("view", help="view block meta/index/columns").add_subparsers(
+        dest="what", required=True
+    )
+    vb = view.add_parser("block")
+    vb.add_argument("tenant")
+    vb.add_argument("block")
+    vb.set_defaults(fn=cmd_view_block)
+    vc = view.add_parser("columns")
+    vc.add_argument("tenant")
+    vc.add_argument("block")
+    vc.set_defaults(fn=cmd_view_columns)
+
+    q = sub.add_parser("query", help="query backend directly").add_subparsers(dest="what", required=True)
+    qt = q.add_parser("trace-id")
+    qt.add_argument("tenant")
+    qt.add_argument("trace_id")
+    qt.set_defaults(fn=cmd_query_trace)
+    qs = q.add_parser("search")
+    qs.add_argument("tenant")
+    qs.add_argument("--tags", default="")
+    qs.add_argument("--q", default="", help="TraceQL query")
+    qs.add_argument("--limit", type=int, default=20)
+    qs.set_defaults(fn=cmd_query_search)
+
+    gen = sub.add_parser("gen", help="regenerate derived objects").add_subparsers(dest="what", required=True)
+    gb = gen.add_parser("bloom")
+    gb.add_argument("tenant")
+    gb.add_argument("block")
+    gb.set_defaults(fn=cmd_gen_bloom)
+    gi = gen.add_parser("index")
+    gi.add_argument("tenant")
+    gi.set_defaults(fn=cmd_gen_index)
+
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    from tempo_tpu.api.params import BadRequest
+    from tempo_tpu.backend.base import NotFound
+
+    try:
+        return args.fn(args)
+    except NotFound as e:
+        print(f"not found: {e or e.__class__.__name__}", file=sys.stderr)
+        return 1
+    except BadRequest as e:
+        print(f"bad argument: {e}", file=sys.stderr)
+        return 2
+    except BrokenPipeError:
+        # output piped into a closed reader (| head): not an error
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -124,30 +124,36 @@ class Poller:
         return m, c
 
     def _scan_tenant(self, tenant: str):
-        metas, compacted = [], []
+        return scan_tenant(self.backend, tenant, pool=self.pool)
 
-        def load(block_id):
-            try:
-                return ("live", self.backend.block_meta(tenant, block_id))
-            except NotFound:
-                pass
-            try:
-                return ("compacted", self.backend.compacted_block_meta(tenant, block_id))
-            except NotFound:
-                return None  # mid-write block without meta yet
 
-        block_ids = self.backend.blocks(tenant)
-        if self.pool is not None:
-            results, errors = self.pool.run_jobs([lambda b=b: load(b) for b in block_ids])
-            if errors:
-                # a transient meta-read failure must abort the poll (keeping
-                # the previous blocklist) rather than silently dropping the
-                # block from query visibility
-                raise errors[0]
-        else:
-            results = [r for r in (load(b) for b in block_ids) if r is not None]
-        for kind, meta in results:
-            (metas if kind == "live" else compacted).append(meta)
-        metas.sort(key=lambda m: m.block_id)
-        compacted.sort(key=lambda c: c.meta.block_id)
-        return metas, compacted
+def scan_tenant(backend, tenant: str, pool=None):
+    """Bucket scan of one tenant: (live metas, compacted metas), both
+    sorted by block id. Shared by the Poller and offline tooling (CLI)."""
+    metas, compacted = [], []
+
+    def load(block_id):
+        try:
+            return ("live", backend.block_meta(tenant, block_id))
+        except NotFound:
+            pass
+        try:
+            return ("compacted", backend.compacted_block_meta(tenant, block_id))
+        except NotFound:
+            return None  # mid-write block without meta yet
+
+    block_ids = backend.blocks(tenant)
+    if pool is not None:
+        results, errors = pool.run_jobs([lambda b=b: load(b) for b in block_ids])
+        if errors:
+            # a transient meta-read failure must abort the poll (keeping
+            # the previous blocklist) rather than silently dropping the
+            # block from query visibility
+            raise errors[0]
+    else:
+        results = [r for r in (load(b) for b in block_ids) if r is not None]
+    for kind, meta in results:
+        (metas if kind == "live" else compacted).append(meta)
+    metas.sort(key=lambda m: m.block_id)
+    compacted.sort(key=lambda c: c.meta.block_id)
+    return metas, compacted
