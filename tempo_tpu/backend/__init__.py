@@ -17,3 +17,27 @@ from tempo_tpu.backend.base import (  # noqa: F401
 )
 from tempo_tpu.backend.local import LocalBackend  # noqa: F401
 from tempo_tpu.backend.mock import MockBackend  # noqa: F401
+
+
+def make_raw_backend(kind: str, options: dict | None = None) -> RawBackend:
+    """Backend factory (reference: tempodb.New backend selection,
+    tempodb/tempodb.go:133-170). Cloud backends are imported lazily so
+    the common local/mock path stays dependency-free."""
+    options = options or {}
+    if kind == "local":
+        return LocalBackend(options.get("path", "blocks"))
+    if kind == "mock":
+        return MockBackend()
+    if kind == "s3":
+        from tempo_tpu.backend.s3 import S3Backend, S3Config
+
+        return S3Backend(S3Config(**options))
+    if kind == "gcs":
+        from tempo_tpu.backend.gcs import GCSBackend, GCSConfig
+
+        return GCSBackend(GCSConfig(**options))
+    if kind == "azure":
+        from tempo_tpu.backend.azure import AzureBackend, AzureConfig
+
+        return AzureBackend(AzureConfig(**options))
+    raise ValueError(f"unknown backend {kind!r} (have local|mock|s3|gcs|azure)")

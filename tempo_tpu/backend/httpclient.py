@@ -1,0 +1,172 @@
+"""HTTP plumbing shared by the cloud object-store backends.
+
+Reference parity:
+- hedged requests against object stores: all three reference backends
+  wrap their HTTP transport in cristalhq/hedgedhttp (e.g.
+  tempodb/backend/gcs/gcs.go, s3/s3.go, azure/azure.go config knobs
+  `hedge_requests_at` / `hedge_requests_up_to`), with hedge counts
+  exported via pkg/hedgedmetrics.
+- retries on transient failures (5xx / connection reset) live in the
+  cloud SDKs the reference vendors; here they are explicit.
+
+Implementation: stdlib http.client with a small per-host connection
+pool. Hedging fires a second identical request after `hedge_at_s` and
+takes the first success — only for idempotent requests (GET/HEAD).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import http.client
+import threading
+import time
+import urllib.parse
+from dataclasses import dataclass
+
+from tempo_tpu.util.metrics import Counter
+
+hedged_total = Counter(
+    "tempo_backend_hedged_roundtrips_total",
+    "Total hedged requests fired (reference: pkg/hedgedmetrics)",
+)
+
+
+class HTTPError(Exception):
+    def __init__(self, status: int, body: bytes, url: str):
+        self.status = status
+        self.body = body[:512]
+        super().__init__(f"HTTP {status} for {url}: {self.body!r}")
+
+
+def retriable(e: Exception) -> bool:
+    if isinstance(e, HTTPError):
+        return e.status >= 500 or e.status == 429
+    return isinstance(e, (ConnectionError, http.client.HTTPException, OSError, TimeoutError))
+
+
+@dataclass
+class HedgeConfig:
+    """hedge_requests_at / hedge_requests_up_to (reference config names)."""
+
+    hedge_at_s: float = 0.0  # 0 = disabled
+    hedge_up_to: int = 2
+
+
+class PooledHTTPClient:
+    """Connection-pooled client for one endpoint (scheme://host:port)."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        timeout_s: float = 30.0,
+        max_retries: int = 3,
+        hedge: HedgeConfig | None = None,
+    ):
+        u = urllib.parse.urlsplit(endpoint)
+        if u.scheme not in ("http", "https"):
+            raise ValueError(f"endpoint must be http(s), got {endpoint!r}")
+        self.scheme = u.scheme
+        self.host = u.hostname or ""
+        self.port = u.port
+        self.timeout_s = timeout_s
+        self.max_retries = max_retries
+        self.hedge = hedge or HedgeConfig()
+        self._pool: list[http.client.HTTPConnection] = []
+        self._lock = threading.Lock()
+        self._hedge_pool = concurrent.futures.ThreadPoolExecutor(max_workers=8)
+
+    # -- connection pool -------------------------------------------------
+    def _get_conn(self) -> http.client.HTTPConnection:
+        with self._lock:
+            if self._pool:
+                return self._pool.pop()
+        cls = http.client.HTTPSConnection if self.scheme == "https" else http.client.HTTPConnection
+        return cls(self.host, self.port, timeout=self.timeout_s)
+
+    def _put_conn(self, conn: http.client.HTTPConnection) -> None:
+        with self._lock:
+            if len(self._pool) < 8:
+                self._pool.append(conn)
+                return
+        conn.close()
+
+    # -- request execution ----------------------------------------------
+    def _once(self, method: str, path: str, headers: dict, body: bytes | None):
+        conn = self._get_conn()
+        try:
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            out_headers = {k.lower(): v for k, v in resp.getheaders()}
+            self._put_conn(conn)
+            return resp.status, data, out_headers
+        except BaseException:
+            conn.close()
+            raise
+
+    def request(
+        self,
+        method: str,
+        path: str,
+        headers: dict | None = None,
+        body: bytes | None = None,
+        ok=(200, 201, 202, 204, 206),
+    ) -> tuple[int, bytes, dict]:
+        """Retrying (and, for idempotent methods, hedged) request.
+
+        Returns (status, body, headers); raises HTTPError for non-ok
+        status after retries are exhausted.
+        """
+        headers = dict(headers or {})
+        headers.setdefault("Host", self.host if self.port is None else f"{self.host}:{self.port}")
+        if body is not None:
+            headers.setdefault("Content-Length", str(len(body)))
+        idempotent = method in ("GET", "HEAD", "PUT", "DELETE")
+
+        last: Exception | None = None
+        for attempt in range(self.max_retries + 1):
+            try:
+                if idempotent and method in ("GET", "HEAD") and self.hedge.hedge_at_s > 0:
+                    status, data, h = self._hedged(method, path, headers, body)
+                else:
+                    status, data, h = self._once(method, path, headers, body)
+                if status in ok:
+                    return status, data, h
+                err = HTTPError(status, data, path)
+                if not retriable(err) or not idempotent:
+                    raise err
+                last = err
+            except HTTPError:
+                raise
+            except Exception as e:  # connection-level failure
+                if not retriable(e) or not idempotent:
+                    raise
+                last = e
+            if attempt < self.max_retries:
+                time.sleep(min(0.05 * (2**attempt), 1.0))
+        assert last is not None
+        raise last
+
+    def _hedged(self, method: str, path: str, headers: dict, body):
+        """First response wins; the straggler is abandoned (its pooled
+        connection is closed by _once's error path or drained later)."""
+        futs = [self._hedge_pool.submit(self._once, method, path, headers, body)]
+        done, _ = concurrent.futures.wait(futs, timeout=self.hedge.hedge_at_s)
+        fired = 1
+        while not done and fired < self.hedge.hedge_up_to:
+            hedged_total.inc()
+            futs.append(self._hedge_pool.submit(self._once, method, path, headers, body))
+            fired += 1
+            done, _ = concurrent.futures.wait(
+                futs, timeout=self.hedge.hedge_at_s, return_when=concurrent.futures.FIRST_COMPLETED
+            )
+        done, _ = concurrent.futures.wait(futs, return_when=concurrent.futures.FIRST_COMPLETED)
+        first = next(iter(done))
+        return first.result()
+
+    def close(self) -> None:
+        with self._lock:
+            for c in self._pool:
+                c.close()
+            self._pool.clear()
+        self._hedge_pool.shutdown(wait=False)

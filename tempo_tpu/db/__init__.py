@@ -19,7 +19,7 @@ import time
 from dataclasses import dataclass, field
 
 from tempo_tpu import encoding as encoding_registry
-from tempo_tpu.backend import LocalBackend, MockBackend, TypedBackend
+from tempo_tpu.backend import TypedBackend, make_raw_backend
 from tempo_tpu.db.blocklist import Blocklist, Poller
 from tempo_tpu.db.compaction import CompactionConfig, CompactionDriver
 from tempo_tpu.db.pool import JobPool
@@ -35,8 +35,12 @@ from tempo_tpu.model.trace import Trace, combine_traces
 
 @dataclass
 class DBConfig:
-    backend: str = "local"  # local | mock
+    backend: str = "local"  # local | mock | s3 | gcs | azure
     backend_path: str = ""
+    backend_options: dict = field(default_factory=dict)  # cloud backend config kwargs
+    cache: str = "none"  # none | memory | memcached (reference: backend cache decorator)
+    cache_options: dict = field(default_factory=dict)
+    cache_background_writes: bool = False
     wal_path: str = ""
     block: BlockConfig = field(default_factory=BlockConfig)
     compaction: CompactionConfig = field(default_factory=CompactionConfig)
@@ -50,13 +54,30 @@ class DBConfig:
 class TempoDB:
     def __init__(self, cfg: DBConfig, raw_backend=None):
         self.cfg = cfg
+        self._cache_client = None
         if raw_backend is None:
+            options = dict(cfg.backend_options)
             if cfg.backend == "local":
-                raw_backend = LocalBackend(cfg.backend_path or os.path.join(os.getcwd(), "blocks"))
-            elif cfg.backend == "mock":
-                raw_backend = MockBackend()
-            else:
-                raise ValueError(f"unknown backend {cfg.backend!r}")
+                options.setdefault(
+                    "path", cfg.backend_path or os.path.join(os.getcwd(), "blocks")
+                )
+            raw_backend = make_raw_backend(cfg.backend, options)
+            # cache wraps only a backend we own — injected backends (the
+            # app sharing one store across ingesters) arrive pre-wrapped
+            if cfg.cache != "none":
+                from tempo_tpu.backend.cache import CachedBackend
+                from tempo_tpu.cache import BackgroundCache, LRUCache, MemcachedCache
+
+                if cfg.cache == "memory":
+                    cache_client = LRUCache(**cfg.cache_options)
+                elif cfg.cache == "memcached":
+                    cache_client = MemcachedCache(**cfg.cache_options)
+                else:
+                    raise ValueError(f"unknown cache {cfg.cache!r} (have none|memory|memcached)")
+                if cfg.cache_background_writes:
+                    cache_client = BackgroundCache(cache_client)
+                self._cache_client = cache_client
+                raw_backend = CachedBackend(raw_backend, cache_client)
         self.backend = TypedBackend(raw_backend)
         self.blocklist = Blocklist()
         self.pool = JobPool(cfg.pool_workers)
@@ -275,6 +296,10 @@ class TempoDB:
         if self._poll_thread:
             self._poll_thread.join(timeout=5)
             self._poll_thread = None
+        if self._cache_client is not None:
+            # drains write-behind queues and closes memcached sockets
+            self._cache_client.stop()
+            self._cache_client = None
 
 
 def _overlaps(meta, start: int, end: int) -> bool:

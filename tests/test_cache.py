@@ -1,0 +1,164 @@
+"""Cache client + backend-cache-decorator tests.
+
+Reference patterns: pkg/cache tests (memcached client against a fake
+server, background write-behind), tempodb/backend/cache tests (bloom
+reads served from cache, write-through)."""
+
+import socket
+import threading
+
+from tempo_tpu.backend.cache import CacheControl, CachedBackend
+from tempo_tpu.backend.mock import MockBackend
+from tempo_tpu.cache import BackgroundCache, LRUCache, MemcachedCache, MockCache
+
+
+class CountingBackend(MockBackend):
+    """MockBackend already counts reads (mocks.go-style instrumentation);
+    n_reads tracks only reads that reached the inner backend."""
+
+    def __init__(self):
+        super().__init__()
+        self.n_reads = 0
+
+    def read(self, name, keypath):
+        self.n_reads += 1
+        return super().read(name, keypath)
+
+    def read_range(self, name, keypath, offset, length):
+        self.n_reads += 1
+        return super().read_range(name, keypath, offset, length)
+
+
+class TestLRU:
+    def test_store_fetch(self):
+        c = LRUCache()
+        c.store(["a", "b"], [b"1", b"2"])
+        found, bufs, missed = c.fetch(["a", "b", "c"])
+        assert found == ["a", "b"] and bufs == [b"1", b"2"] and missed == ["c"]
+
+    def test_eviction_by_bytes(self):
+        c = LRUCache(max_bytes=10)
+        c.store(["a"], [b"x" * 6])
+        c.store(["b"], [b"y" * 6])  # evicts a
+        found, _, missed = c.fetch(["a", "b"])
+        assert missed == ["a"] and found == ["b"]
+
+    def test_lru_order(self):
+        c = LRUCache(max_bytes=12)
+        c.store(["a"], [b"x" * 6])
+        c.store(["b"], [b"y" * 6])
+        c.fetch(["a"])  # a is now most-recent
+        c.store(["c"], [b"z" * 6])  # evicts b
+        found, _, missed = c.fetch(["a", "b", "c"])
+        assert missed == ["b"] and found == ["a", "c"]
+
+
+class _FakeMemcached:
+    """Minimal memcached text-protocol server."""
+
+    def __init__(self):
+        self.data = {}
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.addr = f"127.0.0.1:{self.sock.getsockname()[1]}"
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,), daemon=True).start()
+
+    def _handle(self, conn):
+        f = conn.makefile("rb")
+        while True:
+            line = f.readline()
+            if not line:
+                return
+            parts = line.strip().split()
+            if parts[0] == b"set":
+                n = int(parts[4])
+                val = f.read(n)
+                f.read(2)
+                self.data[parts[1].decode()] = val
+                conn.sendall(b"STORED\r\n")
+            elif parts[0] == b"get":
+                out = b""
+                for k in parts[1:]:
+                    v = self.data.get(k.decode())
+                    if v is not None:
+                        out += b"VALUE %s 0 %d\r\n%s\r\n" % (k, len(v), v)
+                conn.sendall(out + b"END\r\n")
+
+    def close(self):
+        self.sock.close()
+
+
+class TestMemcached:
+    def test_roundtrip(self):
+        srv = _FakeMemcached()
+        c = MemcachedCache([srv.addr])
+        c.store(["k1", "k2"], [b"v1", b"v2"])
+        found, bufs, missed = c.fetch(["k1", "k2", "k3"])
+        assert found == ["k1", "k2"] and bufs == [b"v1", b"v2"] and missed == ["k3"]
+        c.stop()
+        srv.close()
+
+    def test_sharding_across_servers(self):
+        s1, s2 = _FakeMemcached(), _FakeMemcached()
+        c = MemcachedCache([s1.addr, s2.addr])
+        keys = [f"key-{i}" for i in range(32)]
+        c.store(keys, [f"v{i}".encode() for i in range(32)])
+        assert s1.data and s2.data  # both servers got a share
+        found, _, missed = c.fetch(keys)
+        assert not missed and len(found) == 32
+        c.stop()
+        s1.close()
+        s2.close()
+
+
+class TestBackground:
+    def test_write_behind(self):
+        inner = MockCache()
+        bg = BackgroundCache(inner)
+        bg.store(["a"], [b"1"])
+        bg.flush()
+        found, bufs, _ = bg.fetch(["a"])
+        assert found == ["a"] and bufs == [b"1"]
+        bg.stop()
+
+
+class TestCachedBackend:
+    def test_bloom_read_cached(self):
+        inner = CountingBackend()
+        be = CachedBackend(inner, MockCache())
+        inner.write("bloom-0", ("t", "b"), b"BLOOMDATA")
+        assert be.read("bloom-0", ("t", "b")) == b"BLOOMDATA"
+        assert be.read("bloom-0", ("t", "b")) == b"BLOOMDATA"
+        assert inner.n_reads == 1  # second read served from cache
+
+    def test_data_not_cached_by_default(self):
+        inner = CountingBackend()
+        be = CachedBackend(inner, MockCache())
+        inner.write("data.bin", ("t", "b"), b"PAYLOAD")
+        be.read("data.bin", ("t", "b"))
+        be.read("data.bin", ("t", "b"))
+        assert inner.n_reads == 2
+
+    def test_write_through_warms_cache(self):
+        inner = CountingBackend()
+        be = CachedBackend(inner, MockCache())
+        be.write("bloom-1", ("t", "b"), b"WARM")
+        assert be.read("bloom-1", ("t", "b")) == b"WARM"
+        assert inner.n_reads == 0
+
+    def test_ranged_reads_cached_when_enabled(self):
+        inner = CountingBackend()
+        be = CachedBackend(inner, MockCache(), CacheControl(cache_data_ranges=True))
+        inner.write("data.bin", ("t", "b"), b"0123456789")
+        assert be.read_range("data.bin", ("t", "b"), 2, 4) == b"2345"
+        assert be.read_range("data.bin", ("t", "b"), 2, 4) == b"2345"
+        assert inner.n_reads == 1

@@ -1,0 +1,400 @@
+"""Cloud backend tests against in-process mock object stores.
+
+Mirrors the reference's e2e pattern of running real protocol emulators
+(minio / fake-gcs-server / azurite, integration/e2e/backend/backend.go):
+each mock speaks the actual wire dialect (S3 XML listings + SigV4
+headers, GCS JSON API, Azure blob REST incl. Put Block / Put Block
+List), so the backends are exercised end-to-end over real HTTP."""
+
+import json
+import threading
+import time
+import urllib.parse
+import xml.sax.saxutils as sx
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tempo_tpu.backend.azure import AzureBackend, AzureConfig
+from tempo_tpu.backend.base import NotFound, TypedBackend
+from tempo_tpu.backend.gcs import GCSBackend, GCSConfig
+from tempo_tpu.backend.httpclient import HedgeConfig, HTTPError, PooledHTTPClient
+from tempo_tpu.backend.s3 import S3Backend, S3Config
+from tempo_tpu.db import DBConfig, TempoDB
+from tempo_tpu.model import synth
+from tempo_tpu.model import trace as tr
+
+
+class _Store:
+    """Shared backing dict for the mock servers."""
+
+    def __init__(self):
+        self.objects: dict[str, bytes] = {}
+        self.staged_blocks: dict[str, dict[str, bytes]] = {}  # azure put-block state
+        self.lock = threading.Lock()
+
+    def list_with_delimiter(self, prefix: str, delimiter: str):
+        dirs, keys = set(), []
+        with self.lock:
+            names = sorted(self.objects)
+        for k in names:
+            if not k.startswith(prefix):
+                continue
+            rest = k[len(prefix):]
+            if delimiter and delimiter in rest:
+                dirs.add(prefix + rest.split(delimiter, 1)[0] + delimiter)
+            else:
+                keys.append(k)
+        return sorted(dirs), keys
+
+
+def _serve(handler_cls, store):
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)
+    srv.store = store
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+class _BaseHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    @property
+    def store(self) -> _Store:
+        return self.server.store
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(n) if n else b""
+
+    def _reply(self, code: int, body: bytes = b"", ctype="application/octet-stream", headers=()):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _ranged(self, data: bytes):
+        rng = self.headers.get("Range") or self.headers.get("x-ms-range")
+        if rng and rng.startswith("bytes="):
+            lo, hi = rng[len("bytes="):].split("-")
+            lo, hi = int(lo), int(hi)
+            self._reply(206, data[lo : hi + 1])
+        else:
+            self._reply(200, data)
+
+
+# ---------------------------------------------------------------- S3 mock
+class _S3Handler(_BaseHandler):
+    def _key(self):
+        path = urllib.parse.unquote(urllib.parse.urlsplit(self.path).path)
+        # /<bucket>/<key>
+        parts = path.lstrip("/").split("/", 1)
+        return parts[1] if len(parts) > 1 else ""
+
+    def do_PUT(self):  # noqa: N802
+        auth = self.headers.get("Authorization", "")
+        if not auth.startswith("AWS4-HMAC-SHA256 Credential=test-access/"):
+            self._reply(403, b"<Error><Code>SignatureDoesNotMatch</Code></Error>")
+            return
+        with self.store.lock:
+            self.store.objects[self._key()] = self._body()
+        self._reply(200)
+
+    def do_GET(self):  # noqa: N802
+        u = urllib.parse.urlsplit(self.path)
+        qs = dict(urllib.parse.parse_qsl(u.query))
+        key = self._key()
+        if "list-type" in qs:
+            dirs, keys = self.store.list_with_delimiter(
+                qs.get("prefix", ""), qs.get("delimiter", "")
+            )
+            xml = "<?xml version='1.0'?><ListBucketResult>"
+            xml += "<IsTruncated>false</IsTruncated>"
+            for d in dirs:
+                xml += f"<CommonPrefixes><Prefix>{sx.escape(d)}</Prefix></CommonPrefixes>"
+            for k in keys:
+                xml += f"<Contents><Key>{sx.escape(k)}</Key></Contents>"
+            xml += "</ListBucketResult>"
+            self._reply(200, xml.encode(), "application/xml")
+            return
+        with self.store.lock:
+            data = self.store.objects.get(key)
+        if data is None:
+            self._reply(404, b"<Error><Code>NoSuchKey</Code></Error>")
+            return
+        self._ranged(data)
+
+    def do_DELETE(self):  # noqa: N802
+        with self.store.lock:
+            existed = self.store.objects.pop(self._key(), None)
+        self._reply(204 if existed is not None else 404)
+
+
+# --------------------------------------------------------------- GCS mock
+class _GCSHandler(_BaseHandler):
+    def do_POST(self):  # noqa: N802
+        u = urllib.parse.urlsplit(self.path)
+        qs = dict(urllib.parse.parse_qsl(u.query))
+        if u.path.startswith("/upload/storage/v1/b/"):
+            name = qs["name"]
+            with self.store.lock:
+                self.store.objects[name] = self._body()
+            self._reply(200, json.dumps({"name": name}).encode(), "application/json")
+        else:
+            self._reply(404)
+
+    def do_GET(self):  # noqa: N802
+        u = urllib.parse.urlsplit(self.path)
+        qs = dict(urllib.parse.parse_qsl(u.query))
+        path = urllib.parse.unquote(u.path)
+        if path.endswith("/o") or path.endswith("/o/"):
+            dirs, keys = self.store.list_with_delimiter(
+                qs.get("prefix", ""), qs.get("delimiter", "")
+            )
+            doc = {"prefixes": dirs, "items": [{"name": k} for k in keys]}
+            self._reply(200, json.dumps(doc).encode(), "application/json")
+            return
+        # /storage/v1/b/<bucket>/o/<object>
+        key = path.split("/o/", 1)[1]
+        with self.store.lock:
+            data = self.store.objects.get(key)
+        if data is None:
+            self._reply(404, b"{}", "application/json")
+            return
+        self._ranged(data)
+
+    def do_DELETE(self):  # noqa: N802
+        key = urllib.parse.unquote(urllib.parse.urlsplit(self.path).path).split("/o/", 1)[1]
+        with self.store.lock:
+            existed = self.store.objects.pop(key, None)
+        self._reply(204 if existed is not None else 404)
+
+
+# ------------------------------------------------------------- Azure mock
+class _AzureHandler(_BaseHandler):
+    def _key(self):
+        # /<account>/<container>/<blob...>
+        path = urllib.parse.unquote(urllib.parse.urlsplit(self.path).path)
+        return path.lstrip("/").split("/", 2)[2]
+
+    def do_PUT(self):  # noqa: N802
+        u = urllib.parse.urlsplit(self.path)
+        qs = dict(urllib.parse.parse_qsl(u.query))
+        key = self._key()
+        if qs.get("comp") == "block":
+            with self.store.lock:
+                self.store.staged_blocks.setdefault(key, {})[qs["blockid"]] = self._body()
+            self._reply(201)
+        elif qs.get("comp") == "blocklist":
+            body = self._body().decode()
+            ids = [
+                seg.split("</", 1)[0]
+                for seg in body.split(">")
+                if "</Uncommitted" in seg or "</Latest" in seg
+            ]
+            # crude but sufficient XML extraction for <Uncommitted>id</Uncommitted>
+            import re
+
+            ids = re.findall(r"<(?:Uncommitted|Latest)>([^<]+)</", body)
+            with self.store.lock:
+                staged = self.store.staged_blocks.pop(key, {})
+                self.store.objects[key] = b"".join(staged[i] for i in ids)
+            self._reply(201)
+        else:
+            with self.store.lock:
+                self.store.objects[key] = self._body()
+            self._reply(201)
+
+    def do_GET(self):  # noqa: N802
+        u = urllib.parse.urlsplit(self.path)
+        qs = dict(urllib.parse.parse_qsl(u.query))
+        if qs.get("comp") == "list":
+            dirs, keys = self.store.list_with_delimiter(
+                qs.get("prefix", ""), qs.get("delimiter", "")
+            )
+            xml = "<?xml version='1.0'?><EnumerationResults><Blobs>"
+            for d in dirs:
+                xml += f"<BlobPrefix><Name>{sx.escape(d)}</Name></BlobPrefix>"
+            for k in keys:
+                xml += f"<Blob><Name>{sx.escape(k)}</Name></Blob>"
+            xml += "</Blobs><NextMarker/></EnumerationResults>"
+            self._reply(200, xml.encode(), "application/xml")
+            return
+        with self.store.lock:
+            data = self.store.objects.get(self._key())
+        if data is None:
+            self._reply(404)
+            return
+        self._ranged(data)
+
+    def do_DELETE(self):  # noqa: N802
+        with self.store.lock:
+            existed = self.store.objects.pop(self._key(), None)
+        self._reply(202 if existed is not None else 404)
+
+
+# ------------------------------------------------------------- fixtures
+@pytest.fixture
+def s3_backend():
+    store = _Store()
+    srv, url = _serve(_S3Handler, store)
+    be = S3Backend(
+        S3Config(bucket="tempo", endpoint=url, access_key="test-access", secret_key="test-secret")
+    )
+    yield be, store
+    srv.shutdown()
+
+
+@pytest.fixture
+def gcs_backend():
+    store = _Store()
+    srv, url = _serve(_GCSHandler, store)
+    be = GCSBackend(GCSConfig(bucket_name="tempo", endpoint=url, token="tok"))
+    yield be, store
+    srv.shutdown()
+
+
+@pytest.fixture
+def azure_backend():
+    store = _Store()
+    srv, url = _serve(_AzureHandler, store)
+    be = AzureBackend(
+        AzureConfig(
+            storage_account_name="devstoreaccount1",
+            storage_account_key="a2V5",  # base64("key")
+            container_name="tempo",
+            endpoint=url + "/devstoreaccount1",
+        )
+    )
+    yield be, store
+    srv.shutdown()
+
+
+def _roundtrip(raw):
+    raw.write("meta.json", ("t1", "blk-a"), b'{"v":1}')
+    raw.write("meta.json", ("t1", "blk-b"), b'{"v":2}')
+    raw.write("meta.json", ("t2", "blk-c"), b'{"v":3}')
+    assert raw.read("meta.json", ("t1", "blk-a")) == b'{"v":1}'
+    assert raw.read_range("meta.json", ("t1", "blk-a")[:2], 1, 3) == b'"v"'
+    assert raw.list(()) == ["t1", "t2"]
+    assert raw.list(("t1",)) == ["blk-a", "blk-b"]
+    assert raw.list_objects(("t1", "blk-a")) == ["meta.json"]
+    # streamed append -> visible after meta write (block write ordering)
+    raw.append("data.bin", ("t1", "blk-d"), b"part1-")
+    raw.append("data.bin", ("t1", "blk-d"), b"part2")
+    raw.write("meta.json", ("t1", "blk-d"), b"{}")
+    assert raw.read("data.bin", ("t1", "blk-d")) == b"part1-part2"
+    raw.delete("meta.json", ("t1", "blk-b"))
+    with pytest.raises(NotFound):
+        raw.read("meta.json", ("t1", "blk-b"))
+    with pytest.raises(NotFound):
+        raw.delete("meta.json", ("t1", "blk-b"))
+
+
+class TestRawRoundtrip:
+    def test_s3(self, s3_backend):
+        _roundtrip(s3_backend[0])
+
+    def test_gcs(self, gcs_backend):
+        _roundtrip(gcs_backend[0])
+
+    def test_azure(self, azure_backend):
+        _roundtrip(azure_backend[0])
+
+    def test_azure_append_streams_blocks(self, azure_backend):
+        be, store = azure_backend
+        be.append("data.bin", ("t", "b"), b"x" * 10)
+        # staged but not yet committed: not readable
+        with pytest.raises(NotFound):
+            be.read("data.bin", ("t", "b"))
+        assert store.staged_blocks  # Put Block actually hit the server
+        be.write("meta.json", ("t", "b"), b"{}")
+        assert be.read("data.bin", ("t", "b")) == b"x" * 10
+
+    def test_s3_rejects_bad_credentials(self, s3_backend):
+        _, url = s3_backend[0].cfg.endpoint, s3_backend[0].cfg.endpoint
+        bad = S3Backend(
+            S3Config(
+                bucket="tempo",
+                endpoint=s3_backend[0].cfg.endpoint,
+                access_key="wrong",
+                secret_key="whatever",
+            )
+        )
+        with pytest.raises(HTTPError) as ei:
+            bad.write("meta.json", ("t", "b"), b"{}")
+        assert ei.value.status == 403
+
+
+class TestEngineOverCloud:
+    """Full engine cycle (write → find → search → compact) over the S3
+    mock — the reference's TestAllInOne-per-backend pattern."""
+
+    def test_engine_cycle_s3(self, tmp_path, s3_backend):
+        raw, _ = s3_backend
+        cfg = DBConfig(wal_path=str(tmp_path / "wal"))
+        db = TempoDB(cfg, raw_backend=raw)
+        traces = synth.make_traces(20, seed=7)
+        db.write_batch("tenant", tr.traces_to_batch(traces[:10]).sorted_by_trace())
+        db.write_batch("tenant", tr.traces_to_batch(traces[10:]).sorted_by_trace())
+        got = db.find("tenant", traces[3].trace_id)
+        assert got is not None and got.span_count() == traces[3].span_count()
+
+        db.poll_now()
+        assert len(db.blocklist.metas("tenant")) == 2
+        compacted = db.compact_once("tenant")
+        assert compacted
+        db.poll_now()
+        assert len(db.blocklist.metas("tenant")) == 1
+        got = db.find("tenant", traces[13].trace_id)
+        assert got is not None
+
+
+class TestHTTPClient:
+    def test_retries_then_succeeds(self):
+        state = {"n": 0}
+
+        class Flaky(_BaseHandler):
+            def do_GET(self):  # noqa: N802
+                state["n"] += 1
+                if state["n"] < 3:
+                    self._reply(500, b"boom")
+                else:
+                    self._reply(200, b"ok")
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), Flaky)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        c = PooledHTTPClient(f"http://127.0.0.1:{srv.server_address[1]}", max_retries=3)
+        status, body, _ = c.request("GET", "/x")
+        assert status == 200 and body == b"ok"
+        assert state["n"] == 3
+        srv.shutdown()
+
+    def test_hedged_request_wins(self):
+        state = {"n": 0}
+
+        class SlowFirst(_BaseHandler):
+            def do_GET(self):  # noqa: N802
+                state["n"] += 1
+                if state["n"] == 1:
+                    time.sleep(1.0)  # straggler
+                self._reply(200, b"fast")
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), SlowFirst)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        c = PooledHTTPClient(
+            f"http://127.0.0.1:{srv.server_address[1]}",
+            hedge=HedgeConfig(hedge_at_s=0.05),
+        )
+        t0 = time.monotonic()
+        status, body, _ = c.request("GET", "/x")
+        assert status == 200 and body == b"fast"
+        assert time.monotonic() - t0 < 0.9  # did not wait for the straggler
+        assert state["n"] >= 2
+        srv.shutdown()
