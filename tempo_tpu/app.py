@@ -18,6 +18,7 @@ from tempo_tpu.modules.compactor_module import CompactorModule
 from tempo_tpu.modules.distributor import Distributor
 from tempo_tpu.modules.frontend import Frontend, FrontendConfig
 from tempo_tpu.modules.generator import Generator
+from tempo_tpu.modules.generator.storage import RemoteWriteConfig, RemoteWriteStorage
 from tempo_tpu.modules.ingester import Ingester, IngesterConfig
 from tempo_tpu.modules.overrides import Limits, Overrides
 from tempo_tpu.modules.querier import Querier
@@ -42,6 +43,9 @@ class AppConfig:
     n_ingesters: int = 1  # in-process ingesters (tests use >1 to exercise RF)
     query_workers: int = 4
     generator_enabled: bool = True
+    # remote-write of generator metrics (reference: modules/generator/storage);
+    # None or an endpoint-less config disables shipping
+    remote_write: "RemoteWriteConfig | None" = None
 
 
 class App:
@@ -68,6 +72,7 @@ class App:
 
         # generator ring + instances
         self.generator = None
+        self.remote_write_storage = None
         gen_clients = {}
         self.generator_ring = None
         if cfg.generator_enabled:
@@ -75,6 +80,8 @@ class App:
             self.generator = Generator(self.overrides, instance_id="generator-0")
             self.generator_ring.register("generator-0")
             gen_clients["generator-0"] = self.generator
+            if cfg.remote_write is not None and cfg.remote_write.endpoint:
+                self.remote_write_storage = RemoteWriteStorage(cfg.remote_write)
 
         self.distributor = Distributor(
             self.ring,
@@ -131,6 +138,8 @@ class App:
             ing.start_loop()
         self.db.enable_polling()
         self.compactor.start()
+        if self.remote_write_storage is not None:
+            self.remote_write_storage.start_loop(self.generator)
 
     def sweep_all(self, immediate: bool = False):
         """Deterministic maintenance for tests/drives."""
@@ -144,4 +153,6 @@ class App:
             ing.stop(flush=True)
         self.workers.stop()
         self.compactor.stop()
+        if self.remote_write_storage is not None:
+            self.remote_write_storage.stop()
         self.db.shutdown()

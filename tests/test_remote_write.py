@@ -1,0 +1,208 @@
+"""Remote-write storage tests.
+
+Reference pattern: integration/e2e/metrics_generator_test.go writes
+spans, then asserts the remote-written series arrive in a real
+Prometheus. Here the "Prometheus" is an in-process server that decodes
+the actual wire format (snappy block compression + prompb protobuf), so
+compatibility is asserted at the byte level."""
+
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from tempo_tpu.modules.generator import Generator
+from tempo_tpu.modules.generator.registry import Sample
+from tempo_tpu.modules.generator.storage import (
+    RemoteWriteConfig,
+    RemoteWriteStorage,
+    TenantRemoteWriter,
+    encode_write_request,
+)
+from tempo_tpu.modules.overrides import Limits, Overrides
+from tempo_tpu.model import synth
+from tempo_tpu.model import trace as tr
+from tempo_tpu.receivers.protowire import fixed64_to_double, iter_fields
+from tempo_tpu.util import snappy
+
+
+# ---------------------------------------------------------------- snappy
+class TestSnappy:
+    def test_roundtrip_texty(self):
+        data = (b"span.kind=server span.kind=client status=ok " * 200)
+        c = snappy.compress(data)
+        assert len(c) < len(data) // 4  # repetitive input actually compresses
+        assert snappy.decompress(c) == data
+
+    def test_roundtrip_random(self):
+        rng = np.random.default_rng(0)
+        data = rng.integers(0, 256, 10000, np.uint8).tobytes()
+        assert snappy.decompress(snappy.compress(data)) == data
+
+    def test_roundtrip_empty_and_tiny(self):
+        for data in (b"", b"a", b"abcd", b"x" * 15):
+            assert snappy.decompress(snappy.compress(data)) == data
+
+    def test_overlapping_copy(self):
+        # RLE-style: copy with offset < length must replicate byte-at-a-time
+        data = b"ab" * 5000
+        assert snappy.decompress(snappy.compress(data)) == data
+
+    def test_known_wire_vector(self):
+        # hand-built stream: varint(5), literal tag len 5, "hello"
+        raw = bytes([5, (5 - 1) << 2]) + b"hello"
+        assert snappy.decompress(raw) == b"hello"
+        # literal "abcd" + copy1(offset=4, len=4): "abcdabcd"
+        raw = bytes([8, (4 - 1) << 2]) + b"abcd" + bytes([((4 - 4) << 2) | 1, 4])
+        assert snappy.decompress(raw) == b"abcdabcd"
+
+    def test_corrupt_inputs_raise(self):
+        with pytest.raises(ValueError):
+            snappy.decompress(bytes([10, (4 - 1) << 2]) + b"abcd")  # length mismatch
+        with pytest.raises(ValueError):
+            snappy.decompress(bytes([4, ((4 - 4) << 2) | 1, 9]))  # copy before start
+        with pytest.raises(ValueError):
+            snappy.decompress(bytes([200, (60 << 2)]))  # truncated
+
+
+# ----------------------------------------------------------- prompb decode
+def decode_write_request(payload: bytes):
+    """Decode prompb.WriteRequest into [(labels_dict, value, ts_ms)]."""
+    series = []
+    for field, wt, val in iter_fields(payload):
+        assert field == 1 and wt == 2
+        labels, samples = {}, []
+        for f2, w2, v2 in iter_fields(val):
+            if f2 == 1:  # Label
+                kv = {}
+                for f3, _, v3 in iter_fields(v2):
+                    kv[f3] = v3.decode()
+                labels[kv[1]] = kv[2]
+            elif f2 == 2:  # Sample
+                value = ts = 0
+                for f3, w3, v3 in iter_fields(v2):
+                    if f3 == 1:
+                        value = fixed64_to_double(v3)
+                    elif f3 == 2:
+                        ts = v3
+                samples.append((value, ts))
+        for value, ts in samples:
+            series.append((labels, value, ts))
+    return series
+
+
+class _FakePrometheus(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    received = None  # set per-server
+    fail_next = None
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_POST(self):  # noqa: N802
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        if self.server.fail_next > 0:
+            self.server.fail_next -= 1
+            self.send_response(500)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        assert self.headers["Content-Encoding"] == "snappy"
+        assert self.headers["X-Prometheus-Remote-Write-Version"] == "0.1.0"
+        payload = snappy.decompress(body)
+        self.server.received.append(
+            (self.headers.get("X-Scope-OrgID"), decode_write_request(payload))
+        )
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+@pytest.fixture
+def prom_server():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakePrometheus)
+    srv.received = []
+    srv.fail_next = 0
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv, f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def _samples():
+    return [
+        Sample("traces_spanmetrics_calls_total", (("service", "api"),), 42.0, 1700000000000),
+        Sample("traces_spanmetrics_calls_total", (("service", "web"),), 7.0, 1700000000000),
+    ]
+
+
+class TestTenantRemoteWriter:
+    def test_send_roundtrip(self, tmp_path, prom_server):
+        srv, url = prom_server
+        w = TenantRemoteWriter(
+            "acme", RemoteWriteConfig(endpoint=url, wal_dir=str(tmp_path))
+        )
+        w.append(_samples())
+        assert w.send_now() == 1
+        assert w.pending() == 0
+        tenant, series = srv.received[0]
+        assert tenant == "acme"
+        assert len(series) == 2
+        labels, value, ts = series[0]
+        assert labels["__name__"] == "traces_spanmetrics_calls_total"
+        assert labels["service"] == "api"
+        assert value == 42.0 and ts == 1700000000000
+
+    def test_failure_keeps_wal_then_retries(self, tmp_path, prom_server):
+        srv, url = prom_server
+        srv.fail_next = 10  # every attempt in the first cycle fails
+        cfg = RemoteWriteConfig(endpoint=url, wal_dir=str(tmp_path), max_retries=0)
+        w = TenantRemoteWriter("acme", cfg)
+        w.append(_samples())
+        assert w.send_now() == 0
+        assert w.pending() == 1  # nothing lost
+        srv.fail_next = 0
+        assert w.send_now() == 1
+        assert w.pending() == 0
+
+    def test_wal_survives_restart(self, tmp_path):
+        cfg = RemoteWriteConfig(wal_dir=str(tmp_path))  # no endpoint: queue only
+        w = TenantRemoteWriter("acme", cfg)
+        w.append(_samples())
+        w.append(_samples())
+        # "crash": new writer over the same dir
+        w2 = TenantRemoteWriter("acme", cfg)
+        assert w2.pending() == 2
+
+    def test_torn_tail_record_dropped(self, tmp_path):
+        cfg = RemoteWriteConfig(wal_dir=str(tmp_path))
+        w = TenantRemoteWriter("acme", cfg)
+        w.append(_samples())
+        with open(w.wal_path, "ab") as f:
+            f.write(b"\xff\xff\x00\x00garbage-without-full-length")
+        assert w.pending() == 1  # intact record kept, torn tail dropped
+
+    def test_wal_cap_drops_oldest(self, tmp_path):
+        cfg = RemoteWriteConfig(wal_dir=str(tmp_path), max_wal_bytes=400)
+        w = TenantRemoteWriter("acme", cfg)
+        for _ in range(20):
+            w.append(_samples())
+        assert w.pending() * (4 + len(encode_write_request(_samples()))) <= 400
+
+
+class TestStorageCycle:
+    def test_collect_and_send_from_generator(self, tmp_path, prom_server):
+        srv, url = prom_server
+        gen = Generator(Overrides(Limits()))
+        batch = tr.traces_to_batch(synth.make_traces(10, seed=5))
+        gen.push_batch("acme", batch)
+        storage = RemoteWriteStorage(RemoteWriteConfig(endpoint=url, wal_dir=str(tmp_path)))
+        sent = storage.collect_and_send(gen)
+        assert sent >= 1
+        tenant, series = srv.received[0]
+        assert tenant == "acme"
+        names = {labels["__name__"] for labels, _, _ in series}
+        assert "traces_spanmetrics_calls_total" in names
+        assert os.path.exists(os.path.join(str(tmp_path), "acme", "remote-write.wal"))
