@@ -201,12 +201,14 @@ class TempoDB:
             out.merge(r, limit=req.limit)
         return out
 
-    def search_block(self, tenant: str, block_id: str, req: SearchRequest) -> SearchResponse:
+    def search_block(self, tenant: str, block_id: str, req: SearchRequest,
+                     start_row_group: int = 0, row_groups: int = 0) -> SearchResponse:
         """Search one specific block (the querier's backend-search job
-        unit, reference: modules/querier SearchBlock:432)."""
+        unit, reference: modules/querier SearchBlock:432), optionally
+        bounded to a row-group subrange (the serverless/page-shard unit)."""
         meta = self.backend.block_meta(tenant, block_id)
         blk = self.encoding_for(meta.version).open_block(meta, self.backend, self.cfg.block)
-        return blk.search(req)
+        return blk.search(req, start_row_group=start_row_group, row_groups=row_groups)
 
     def fetch_candidates(self, tenant: str, spec, start_s: int = 0, end_s: int = 0):
         """TraceQL candidate fetch across blocks; traces straddling

@@ -128,15 +128,22 @@ class VtpuBackendBlock:
     # tag search
     # ------------------------------------------------------------------
 
-    def search(self, req: SearchRequest) -> SearchResponse:
+    def search(self, req: SearchRequest, start_row_group: int = 0,
+               row_groups: int = 0) -> SearchResponse:
+        """start_row_group/row_groups bound the scan to a page subrange —
+        the unit of the frontend's job sharding and the serverless
+        contract (reference: api.SearchBlockRequest StartPage/PagesToSearch,
+        cmd/tempo-serverless/handler.go:53). row_groups=0 = all remaining."""
         bytes_before = self.bytes_read
         resp = SearchResponse(inspected_blocks=1)
         d = self.dictionary()
 
+        all_rgs = self.index().row_groups
+        end_rg = (start_row_group + row_groups) if row_groups else len(all_rgs)
         # resolve string predicates against the dictionary once per block
         preds = _resolve_tag_predicates(req, d)
         if preds is not None:  # None -> a predicate can never match here
-            for rg in self.index().row_groups:
+            for rg in all_rgs[start_row_group:end_rg]:
                 if req.start_seconds and rg.end_s < req.start_seconds:
                     continue
                 if req.end_seconds and rg.start_s > req.end_seconds:

@@ -22,12 +22,21 @@ log = logging.getLogger(__name__)
 
 
 class Querier:
-    def __init__(self, db, ring=None, ingester_clients: dict | None = None):
+    def __init__(self, db, ring=None, ingester_clients: dict | None = None,
+                 external_endpoints: list | None = None):
         """ingester_clients: instance_id -> object with
-        find_trace_by_id(tenant, tid) and live_batches(tenant)."""
+        find_trace_by_id(tenant, tid) and live_batches(tenant).
+
+        external_endpoints: serverless search URLs; when set, backend
+        block-search jobs are delegated round-robin (reference:
+        searchExternalEndpoint querier.go:540, config
+        search_external_endpoints)."""
         self.db = db
         self.ring = ring
         self.ingester_clients = ingester_clients or {}
+        self.external_endpoints = list(external_endpoints or [])
+        self._ext_clients = None
+        self._ext_rr = 0
 
     # ------------------------------------------------------------------
     def _replica_clients(self, tenant: str, trace_id: bytes):
@@ -84,8 +93,54 @@ class Querier:
         out.merge(self.search_blocks(tenant, req), limit=req.limit)
         return out
 
-    def search_block_job(self, tenant: str, block_id: str, req: SearchRequest) -> SearchResponse:
-        return self.db.search_block(tenant, block_id, req)
+    def search_block_job(self, tenant: str, block_id: str, req: SearchRequest,
+                         start_row_group: int = 0, row_groups: int = 0) -> SearchResponse:
+        if self.external_endpoints:
+            return self._search_external(tenant, block_id, req, start_row_group, row_groups)
+        return self.db.search_block(tenant, block_id, req,
+                                    start_row_group=start_row_group, row_groups=row_groups)
+
+    def _search_external(self, tenant, block_id, req, start_row_group, row_groups) -> SearchResponse:
+        """Delegate one block-search job to a serverless endpoint."""
+        import urllib.parse
+
+        from tempo_tpu.api.params import SearchBlockRequest, build_search_block_params
+        from tempo_tpu.backend.httpclient import PooledHTTPClient
+
+        if self._ext_clients is None:
+            self._ext_clients = []
+            for ep in self.external_endpoints:
+                u = urllib.parse.urlsplit(ep)
+                self._ext_clients.append(
+                    (PooledHTTPClient(f"{u.scheme}://{u.netloc}"), u.path or "/")
+                )
+        client, path = self._ext_clients[self._ext_rr % len(self._ext_clients)]
+        self._ext_rr += 1
+        sbr = SearchBlockRequest(search=req, block_id=block_id,
+                                 start_row_group=start_row_group, row_groups=row_groups)
+        qs = urllib.parse.urlencode(build_search_block_params(sbr))
+        _, body, _ = client.request(
+            "GET", f"{path}?{qs}", headers={"X-Scope-OrgID": tenant}, ok=(200,)
+        )
+        import json
+
+        doc = json.loads(body)
+        resp = SearchResponse()
+        for t in doc.get("traces", []):
+            resp.traces.append(
+                TraceSearchMetadata(
+                    trace_id_hex=t["traceID"],
+                    root_service_name=t.get("rootServiceName", ""),
+                    root_trace_name=t.get("rootTraceName", ""),
+                    start_time_unix_nano=int(t.get("startTimeUnixNano", "0")),
+                    duration_ms=t.get("durationMs", 0),
+                )
+            )
+        m = doc.get("metrics", {})
+        resp.inspected_traces = m.get("inspectedTraces", 0)
+        resp.inspected_bytes = int(m.get("inspectedBytes", "0"))
+        resp.inspected_blocks = m.get("inspectedBlocks", 0)
+        return resp
 
     def search_tags(self, tenant: str) -> list[str]:
         """Tag names in not-yet-flushed ingester data (reference:

@@ -1,0 +1,55 @@
+"""Deterministic trace construction for blackbox checking.
+
+Reference: pkg/util/trace_info.go — a TraceInfo is seeded by
+(timestamp, tenant) so the vulture can WRITE a trace at time T and
+later RECONSTRUCT exactly what it wrote from T alone, comparing it
+against what the backend returns. No state needs to survive between
+the writer and the checker.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tempo_tpu.model import synth
+from tempo_tpu.model.trace import Trace
+
+
+def _fnv64(data: bytes) -> int:
+    h = 0xCBF29CE484222325
+    for b in data:
+        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+@dataclass(frozen=True)
+class TraceInfo:
+    timestamp_s: int
+    tenant: str = "single-tenant"
+
+    @property
+    def seed(self) -> int:
+        return _fnv64(self.tenant.encode() + self.timestamp_s.to_bytes(8, "little"))
+
+    def trace_id(self) -> bytes:
+        """Stable ID — derived from the seed, not from the generator
+        stream, so it can be computed without building the trace."""
+        a = self.seed
+        b = _fnv64(b"id" + a.to_bytes(8, "little"))
+        return a.to_bytes(8, "big") + b.to_bytes(8, "big")
+
+    def construct_trace(self) -> Trace:
+        """The exact trace the vulture wrote at timestamp_s."""
+        return synth.make_trace(
+            seed=self.seed,
+            base_time_ns=self.timestamp_s * 10**9,
+            trace_id=self.trace_id(),
+        )
+
+    def ready(self, now_s: int, write_backoff_s: int, long_write_backoff_s: int) -> bool:
+        """Whether this timestamp is one the vulture would have written
+        (aligned to the write cadence) and old enough to be queryable
+        (reference: trace_info.go ready-semantics)."""
+        if self.timestamp_s % max(write_backoff_s, 1) != 0:
+            return False
+        return now_s - self.timestamp_s >= long_write_backoff_s

@@ -1,0 +1,229 @@
+"""Vulture — continuous blackbox consistency checker.
+
+Reference: cmd/tempo-vulture/main.go — a sidecar that perpetually
+writes deterministic traces (seeded by timestamp, pkg/util/trace_info.go),
+re-reads them by ID and by search, and exports error-rate metrics that
+production alerting watches. `traceMetrics` (main.go:48) counts
+requested / requestFailed / notFound / missingSpans / incorrectResult.
+
+Clients are pluggable: InProcessClient drives an App directly (the
+all-in-one deployment), HTTPClient drives a remote tempo_tpu server
+over the OTLP push + query HTTP API, byte-for-byte the way an external
+vulture process would.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.parse
+
+from tempo_tpu.encoding.common import SearchRequest
+from tempo_tpu.util.metrics import Counter
+from tempo_tpu.util.traceinfo import TraceInfo
+
+log = logging.getLogger(__name__)
+
+vulture_traces_written = Counter("tempo_vulture_trace_total", "Traces written by vulture")
+vulture_errors = Counter(
+    "tempo_vulture_error_total",
+    "Vulture check failures by type (notfound_byid | missing_spans | "
+    "notfound_search | request_failed)",
+)
+
+
+class InProcessClient:
+    """Drives an App in the same process (all-in-one deployment)."""
+
+    def __init__(self, app, tenant: str | None = None):
+        self.app = app
+        self.tenant = tenant
+
+    def push(self, traces) -> None:
+        self.app.push_traces(traces, org_id=self.tenant)
+
+    def query(self, trace_id: bytes):
+        return self.app.find_trace(trace_id, org_id=self.tenant)
+
+    def search(self, req: SearchRequest) -> list[str]:
+        resp = self.app.search(req, org_id=self.tenant)
+        return [t.trace_id_hex for t in resp.traces]
+
+
+class HTTPClient:
+    """Drives a tempo_tpu server over HTTP (OTLP push + query API)."""
+
+    def __init__(self, base_url: str, tenant: str | None = None):
+        from tempo_tpu.backend.httpclient import PooledHTTPClient
+
+        self.client = PooledHTTPClient(base_url)
+        self.tenant = tenant
+
+    def _headers(self, extra=None) -> dict:
+        h = dict(extra or {})
+        if self.tenant:
+            h["X-Scope-OrgID"] = self.tenant
+        return h
+
+    def push(self, traces) -> None:
+        from tempo_tpu.receivers import otlp
+
+        self.client.request(
+            "POST",
+            "/v1/traces",
+            headers=self._headers({"Content-Type": "application/x-protobuf"}),
+            body=otlp.encode_traces_request(traces),
+            ok=(200,),
+        )
+
+    def query(self, trace_id: bytes):
+        from tempo_tpu.backend.httpclient import HTTPError
+        from tempo_tpu.receivers import otlp
+
+        try:
+            _, body, _ = self.client.request(
+                "GET",
+                f"/api/traces/{trace_id.hex()}",
+                headers=self._headers({"Accept": "application/protobuf"}),
+                ok=(200,),
+            )
+        except HTTPError as e:
+            if e.status == 404:
+                return None
+            raise
+        traces = otlp.decode_traces_request(body)
+        return traces[0] if traces else None
+
+    def search(self, req: SearchRequest) -> list[str]:
+        tags = " ".join(f"{k}={v}" for k, v in req.tags.items())
+        qs = {"tags": tags, "limit": str(req.limit or 20)}
+        if req.start_seconds:
+            qs["start"] = str(req.start_seconds)
+        if req.end_seconds:
+            qs["end"] = str(req.end_seconds)
+        _, body, _ = self.client.request(
+            "GET",
+            "/api/search?" + urllib.parse.urlencode(qs),
+            headers=self._headers(),
+            ok=(200,),
+        )
+        return [t["traceID"] for t in json.loads(body).get("traces", [])]
+
+
+class Vulture:
+    def __init__(
+        self,
+        client,
+        tenant: str = "single-tenant",
+        write_backoff_s: int = 10,
+        read_backoff_s: int = 10,
+        search_backoff_s: int = 0,  # 0 disables search checks
+        retention_s: int = 3600,
+    ):
+        self.client = client
+        self.tenant = tenant
+        self.write_backoff_s = write_backoff_s
+        self.read_backoff_s = read_backoff_s
+        self.search_backoff_s = search_backoff_s
+        self.retention_s = retention_s
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # -- one write / one check (deterministically drivable) -------------
+    def write_once(self, now_s: int | None = None) -> TraceInfo:
+        now_s = int(now_s if now_s is not None else time.time())
+        now_s -= now_s % self.write_backoff_s  # align to cadence
+        info = TraceInfo(now_s, self.tenant)
+        self.client.push([info.construct_trace()])
+        vulture_traces_written.inc()
+        return info
+
+    def _pick_readable(self, now_s: int, min_age_s: int) -> TraceInfo | None:
+        """Newest cadence-aligned timestamp old enough to be queryable
+        but inside retention (reference: vulture selectPastTimestamp)."""
+        newest = now_s - min_age_s
+        newest -= newest % self.write_backoff_s
+        oldest = now_s - self.retention_s
+        if newest < oldest:
+            return None
+        return TraceInfo(newest, self.tenant)
+
+    def check_by_id(self, now_s: int | None = None, min_age_s: int = 0) -> bool:
+        now_s = int(now_s if now_s is not None else time.time())
+        info = self._pick_readable(now_s, min_age_s)
+        if info is None:
+            return True
+        expected = info.construct_trace()
+        try:
+            got = self.client.query(info.trace_id())
+        except Exception as e:
+            log.warning("vulture query failed: %s", e)
+            vulture_errors.inc(error_type="request_failed")
+            return False
+        if got is None:
+            vulture_errors.inc(error_type="notfound_byid")
+            return False
+        want_ids = {s.span_id for s in expected.all_spans()}
+        got_ids = {s.span_id for s in got.all_spans()}
+        if not want_ids <= got_ids:
+            vulture_errors.inc(error_type="missing_spans")
+            return False
+        return True
+
+    def check_search(self, now_s: int | None = None, min_age_s: int = 0) -> bool:
+        now_s = int(now_s if now_s is not None else time.time())
+        info = self._pick_readable(now_s, min_age_s)
+        if info is None:
+            return True
+        expected = info.construct_trace()
+        # search by the root service (always present in the written trace)
+        service = expected.batches[0][0].get("service.name", "")
+        req = SearchRequest(
+            tags={"service": service},
+            start_seconds=info.timestamp_s - 60,
+            end_seconds=info.timestamp_s + 60,
+            limit=0,
+        )
+        try:
+            hits = self.client.search(req)
+        except Exception as e:
+            log.warning("vulture search failed: %s", e)
+            vulture_errors.inc(error_type="request_failed")
+            return False
+        if info.trace_id().hex() not in hits:
+            vulture_errors.inc(error_type="notfound_search")
+            return False
+        return True
+
+    # -- loops -----------------------------------------------------------
+    def start(self) -> None:
+        def writer():
+            while not self._stop.wait(self.write_backoff_s):
+                try:
+                    self.write_once()
+                except Exception as e:
+                    log.warning("vulture write failed: %s", e)
+                    vulture_errors.inc(error_type="request_failed")
+
+        def reader():
+            while not self._stop.wait(self.read_backoff_s):
+                self.check_by_id(min_age_s=self.read_backoff_s)
+
+        self._threads = [threading.Thread(target=writer, daemon=True)]
+        self._threads.append(threading.Thread(target=reader, daemon=True))
+        if self.search_backoff_s:
+            def searcher():
+                while not self._stop.wait(self.search_backoff_s):
+                    self.check_search(min_age_s=self.search_backoff_s)
+
+            self._threads.append(threading.Thread(target=searcher, daemon=True))
+        for t in self._threads:
+            t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+        self._threads = []

@@ -1,0 +1,126 @@
+"""Serverless stateless-search tests.
+
+Reference pattern: integration/e2e/serverless — querier delegates
+backend search jobs to an external endpoint; the handler searches one
+block (or a page subrange) per request."""
+
+import urllib.parse
+
+import pytest
+
+from tempo_tpu.api.params import SearchBlockRequest, build_search_block_params
+from tempo_tpu.backend.httpclient import PooledHTTPClient
+from tempo_tpu.db import DBConfig, TempoDB
+from tempo_tpu.encoding.common import BlockConfig, SearchRequest
+from tempo_tpu.model import synth
+from tempo_tpu.model import trace as tr
+from tempo_tpu.modules.querier import Querier
+from tempo_tpu.serverless import SearchBlockHandler, ServerlessServer
+
+
+@pytest.fixture
+def db_with_block(tmp_path):
+    cfg = DBConfig(
+        backend="local",
+        backend_path=str(tmp_path / "blocks"),
+        wal_path=str(tmp_path / "wal"),
+        # small row groups so subrange requests are meaningful
+        block=BlockConfig(row_group_spans=64),
+    )
+    db = TempoDB(cfg)
+    traces = synth.make_traces(40, seed=21)
+    db.write_batch("acme", tr.traces_to_batch(traces).sorted_by_trace())
+    db.poll_now()
+    meta = db.blocklist.metas("acme")[0]
+    return db, meta, traces
+
+
+def _service_of(trace):
+    return trace.batches[0][0]["service.name"]
+
+
+class TestHandler:
+    def test_search_one_block(self, tmp_path, db_with_block):
+        db, meta, traces = db_with_block
+        h = SearchBlockHandler("local", {"path": str(tmp_path / "blocks")})
+        want = traces[5]
+        qs = {
+            "blockID": [meta.block_id],
+            "tags": [f"service={_service_of(want)}"],
+            "limit": ["100"],
+        }
+        resp = h.handle(qs, "acme")
+        assert want.trace_id.hex() in {t.trace_id_hex for t in resp.traces}
+
+    def test_row_group_subrange_partitions_block(self, tmp_path, db_with_block):
+        db, meta, traces = db_with_block
+        h = SearchBlockHandler("local", {"path": str(tmp_path / "blocks")})
+        blk = db.encoding_for(meta.version).open_block(meta, db.backend, db.cfg.block)
+        n_rgs = len(blk.index().row_groups)
+        assert n_rgs > 1
+        whole = h.handle({"blockID": [meta.block_id], "limit": ["100"]}, "acme")
+        parts = []
+        for rg in range(n_rgs):
+            resp = h.handle(
+                {
+                    "blockID": [meta.block_id],
+                    "startRowGroup": [str(rg)],
+                    "rowGroups": ["1"],
+                    "limit": ["100"],
+                },
+                "acme",
+            )
+            parts.extend(t.trace_id_hex for t in resp.traces)
+        assert sorted(parts) == sorted(t.trace_id_hex for t in whole.traces)
+
+    def test_bad_requests(self, tmp_path, db_with_block):
+        from tempo_tpu.api.params import BadRequest
+
+        h = SearchBlockHandler("local", {"path": str(tmp_path / "blocks")})
+        with pytest.raises(BadRequest):
+            h.handle({}, "acme")  # no blockID
+        with pytest.raises(BadRequest):
+            h.handle({"blockID": ["x"]}, "")  # no tenant
+        db, meta, _ = db_with_block
+        with pytest.raises(BadRequest):
+            h.handle({"blockID": [meta.block_id], "version": ["other-enc"]}, "acme")
+
+
+class TestOverHTTP:
+    def test_server_roundtrip(self, tmp_path, db_with_block):
+        db, meta, traces = db_with_block
+        srv = ServerlessServer(
+            SearchBlockHandler("local", {"path": str(tmp_path / "blocks")})
+        ).start()
+        try:
+            sbr = SearchBlockRequest(
+                search=SearchRequest(tags={"service": _service_of(traces[0])}, limit=100),
+                block_id=meta.block_id,
+            )
+            qs = urllib.parse.urlencode(build_search_block_params(sbr))
+            c = PooledHTTPClient(srv.url)
+            status, body, _ = c.request("GET", f"/?{qs}", headers={"X-Scope-OrgID": "acme"})
+            assert status == 200
+            import json
+
+            doc = json.loads(body)
+            assert traces[0].trace_id.hex() in {t["traceID"] for t in doc["traces"]}
+            # errors map to HTTP codes
+            status, _, _ = c.request("GET", "/?limit=0", headers={"X-Scope-OrgID": "acme"}, ok=(400,))
+            assert status == 400
+        finally:
+            srv.stop()
+
+    def test_querier_delegates_to_external_endpoint(self, tmp_path, db_with_block):
+        db, meta, traces = db_with_block
+        srv = ServerlessServer(
+            SearchBlockHandler("local", {"path": str(tmp_path / "blocks")})
+        ).start()
+        try:
+            q = Querier(db, external_endpoints=[srv.url + "/"])
+            req = SearchRequest(tags={"service": _service_of(traces[3])}, limit=100)
+            resp = q.search_block_job("acme", meta.block_id, req)
+            assert traces[3].trace_id.hex() in {t.trace_id_hex for t in resp.traces}
+            assert resp.inspected_blocks == 1
+        finally:
+            srv.stop()

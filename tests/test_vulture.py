@@ -1,0 +1,106 @@
+"""Vulture blackbox-checker tests.
+
+Reference pattern: the vulture runs against a real deployment; here it
+runs in-process against the all-in-one App and over real HTTP against
+TempoServer (the reference's continuous prod check, compressed into a
+deterministic test)."""
+
+import pytest
+
+from tempo_tpu.app import App, AppConfig
+from tempo_tpu.db import DBConfig
+from tempo_tpu.util.traceinfo import TraceInfo
+from tempo_tpu.vulture import HTTPClient, InProcessClient, Vulture, vulture_errors
+
+
+@pytest.fixture
+def app(tmp_path):
+    cfg = AppConfig(
+        db=DBConfig(
+            backend="local",
+            backend_path=str(tmp_path / "blocks"),
+            wal_path=str(tmp_path / "wal"),
+        ),
+        generator_enabled=False,
+    )
+    a = App(cfg)
+    yield a
+    a.shutdown()
+
+
+class TestTraceInfo:
+    def test_deterministic(self):
+        a = TraceInfo(1700000000, "acme")
+        b = TraceInfo(1700000000, "acme")
+        assert a.trace_id() == b.trace_id()
+        ta, tb = a.construct_trace(), b.construct_trace()
+        assert ta.trace_id == tb.trace_id == a.trace_id()
+        assert [s.span_id for s in ta.all_spans()] == [s.span_id for s in tb.all_spans()]
+
+    def test_varies_by_tenant_and_time(self):
+        base = TraceInfo(1700000000, "acme")
+        assert base.trace_id() != TraceInfo(1700000000, "other").trace_id()
+        assert base.trace_id() != TraceInfo(1700000010, "acme").trace_id()
+
+    def test_ready_alignment(self):
+        info = TraceInfo(1700000000)  # divisible by 10
+        assert info.ready(1700000100, write_backoff_s=10, long_write_backoff_s=30)
+        assert not info.ready(1700000010, 10, 30)  # too fresh
+        assert not TraceInfo(1700000003).ready(1700000100, 10, 30)  # off-cadence
+
+
+class TestVultureInProcess:
+    def test_write_then_check_ok(self, app):
+        v = Vulture(InProcessClient(app), write_backoff_s=10)
+        now = 1700000000
+        info = v.write_once(now)
+        app.sweep_all(immediate=True)  # make it queryable from blocks too
+        assert v.check_by_id(now, min_age_s=0)
+        assert v.check_search(now, min_age_s=0)
+        assert info.trace_id() == TraceInfo(now, v.tenant).trace_id()
+
+    def test_detects_missing_trace(self, app):
+        v = Vulture(InProcessClient(app), write_backoff_s=10)
+        base = vulture_errors.value(error_type="notfound_byid")
+        # nothing was ever written for this timestamp
+        assert not v.check_by_id(1690000000, min_age_s=0)
+        assert vulture_errors.value(error_type="notfound_byid") == base + 1
+
+    def test_detects_missing_spans(self, app):
+        v = Vulture(InProcessClient(app), write_backoff_s=10)
+        now = 1700000000
+        info = TraceInfo(now, v.tenant)
+        full = info.construct_trace()
+        # write a mutilated version: drop one span
+        resource, spans = full.batches[0]
+        mutilated = type(full)(trace_id=full.trace_id, batches=[(resource, spans[:-1])])
+        for r, s in full.batches[1:]:
+            mutilated.batches.append((r, s))
+        app.push_traces([mutilated])
+        base = vulture_errors.value(error_type="missing_spans")
+        assert not v.check_by_id(now, min_age_s=0)
+        assert vulture_errors.value(error_type="missing_spans") == base + 1
+
+    def test_outside_retention_skipped(self, app):
+        v = Vulture(InProcessClient(app), write_backoff_s=10, retention_s=100)
+        # readable window is empty: min_age pushes past retention
+        assert v.check_by_id(1700000000, min_age_s=200)
+
+
+class TestVultureHTTP:
+    def test_full_cycle_over_http(self, app):
+        from tempo_tpu.api.server import TempoServer
+
+        srv = TempoServer(app).start()
+        try:
+            v = Vulture(HTTPClient(srv.url), write_backoff_s=10)
+            now = 1700000000
+            v.write_once(now)
+            app.sweep_all(immediate=True)
+            assert v.check_by_id(now, min_age_s=0)
+            assert v.check_search(now, min_age_s=0)
+            base = vulture_errors.value(error_type="notfound_byid")
+            assert not v.check_by_id(1690000000, min_age_s=0)
+            assert vulture_errors.value(error_type="notfound_byid") == base + 1
+        finally:
+            srv.stop()
