@@ -138,11 +138,12 @@ class VtpuBackendBlock:
         resp = SearchResponse(inspected_blocks=1)
         d = self.dictionary()
 
-        all_rgs = self.index().row_groups
-        end_rg = (start_row_group + row_groups) if row_groups else len(all_rgs)
-        # resolve string predicates against the dictionary once per block
+        # resolve string predicates against the dictionary once per block;
+        # an impossible predicate must return before any index/page IO
         preds = _resolve_tag_predicates(req, d)
         if preds is not None:  # None -> a predicate can never match here
+            all_rgs = self.index().row_groups
+            end_rg = (start_row_group + row_groups) if row_groups else len(all_rgs)
             for rg in all_rgs[start_row_group:end_rg]:
                 if req.start_seconds and rg.end_s < req.start_seconds:
                     continue
