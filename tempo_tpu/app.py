@@ -46,6 +46,10 @@ class AppConfig:
     # remote-write of generator metrics (reference: modules/generator/storage);
     # None or an endpoint-less config disables shipping
     remote_write: "RemoteWriteConfig | None" = None
+    # configured forwarders; tenants opt in via overrides `forwarders`
+    forwarders: list = field(default_factory=list)  # list[ForwarderConfig]
+    # anonymous usage reporting (reference: pkg/usagestats; off by default)
+    usage_stats: "object | None" = None  # usagestats.UsageStatsConfig
 
 
 class App:
@@ -83,18 +87,31 @@ class App:
             if cfg.remote_write is not None and cfg.remote_write.endpoint:
                 self.remote_write_storage = RemoteWriteStorage(cfg.remote_write)
 
+        self.forwarder_manager = None
+        if cfg.forwarders:
+            from tempo_tpu.modules.forwarder import ForwarderManager
+
+            self.forwarder_manager = ForwarderManager(cfg.forwarders, self.overrides)
+
         self.distributor = Distributor(
             self.ring,
             ingester_clients=self.ingesters,
             overrides=self.overrides,
             generator_ring=self.generator_ring,
             generator_clients=gen_clients,
+            forwarder_manager=self.forwarder_manager,
         )
         self.querier = Querier(self.db, self.ring, ingester_clients=self.ingesters)
         self.queue = RequestQueue()
         self.workers = WorkerPool(self.queue, n_workers=cfg.query_workers)
         self.frontend = Frontend(self.queue, self.querier, cfg.frontend, self.overrides)
         self.compactor = CompactorModule(self.db, ring=None)
+
+        self.usage_reporter = None
+        if cfg.usage_stats is not None and getattr(cfg.usage_stats, "enabled", False):
+            from tempo_tpu.usagestats import Reporter
+
+            self.usage_reporter = Reporter(cfg.usage_stats, self.db.backend.raw)
 
         # heartbeat every registered member — without this the whole ring
         # goes unhealthy after heartbeat_timeout_s and ingest stops
@@ -140,6 +157,8 @@ class App:
         self.compactor.start()
         if self.remote_write_storage is not None:
             self.remote_write_storage.start_loop(self.generator)
+        if self.usage_reporter is not None:
+            self.usage_reporter.start_loop()
 
     def sweep_all(self, immediate: bool = False):
         """Deterministic maintenance for tests/drives."""
@@ -155,4 +174,8 @@ class App:
         self.compactor.stop()
         if self.remote_write_storage is not None:
             self.remote_write_storage.stop()
+        if self.forwarder_manager is not None:
+            self.forwarder_manager.stop()
+        if self.usage_reporter is not None:
+            self.usage_reporter.stop()
         self.db.shutdown()

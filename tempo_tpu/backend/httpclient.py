@@ -23,9 +23,9 @@ import time
 import urllib.parse
 from dataclasses import dataclass
 
-from tempo_tpu.util.metrics import Counter
+from tempo_tpu.util import metrics
 
-hedged_total = Counter(
+hedged_total = metrics.counter(
     "tempo_backend_hedged_roundtrips_total",
     "Total hedged requests fired (reference: pkg/hedgedmetrics)",
 )

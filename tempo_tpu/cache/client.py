@@ -14,11 +14,11 @@ import socket
 import threading
 from collections import OrderedDict, deque
 
-from tempo_tpu.util.metrics import Counter
+from tempo_tpu.util import metrics
 
-cache_hits = Counter("tempo_cache_hits_total", "Cache fetch hits")
-cache_misses = Counter("tempo_cache_misses_total", "Cache fetch misses")
-cache_dropped = Counter(
+cache_hits = metrics.counter("tempo_cache_hits_total", "Cache fetch hits")
+cache_misses = metrics.counter("tempo_cache_misses_total", "Cache fetch misses")
+cache_dropped = metrics.counter(
     "tempo_cache_background_writes_dropped_total",
     "Write-behind queue overflow drops (reference: background.go droppedWriteBack)",
 )

@@ -31,6 +31,7 @@ from tempo_tpu.encoding.common import (
     SearchResponse,
 )
 from tempo_tpu.model.trace import Trace, combine_traces
+from tempo_tpu.util import tracing
 
 
 @dataclass
@@ -153,8 +154,15 @@ class TempoDB:
              block_start: str = "0" * 32, block_end: str = "f" * 32,
              time_start: int = 0, time_end: int = 0) -> Trace | None:
         """Trace-by-ID across blocks (reference: tempodb.Find:272 with
-        includeBlock shard-range + time filtering :494-517). Partial
-        traces from multiple blocks are combined."""
+        includeBlock shard-range + time filtering :494-517; self-traced
+        like the reference's tempodb.go:276 span). Partial traces from
+        multiple blocks are combined."""
+        with tracing.span("tempodb.Find", tenant=tenant):
+            return self._find_traced(tenant, trace_id, block_start, block_end,
+                                     time_start, time_end)
+
+    def _find_traced(self, tenant, trace_id, block_start, block_end,
+                     time_start, time_end) -> Trace | None:
         hex_id = trace_id.hex().rjust(32, "0")
         metas = [
             m for m in self.blocklist.metas(tenant)

@@ -21,6 +21,7 @@ from tempo_tpu.encoding.vtpu import format as fmt
 from tempo_tpu.model.columnar import SpanBatch
 from tempo_tpu.model.trace import traces_to_batch
 from tempo_tpu.ops import hashing
+from tempo_tpu.util import tracing
 
 log = logging.getLogger(__name__)
 
@@ -63,7 +64,7 @@ class DistributorMetrics:
 class Distributor:
     def __init__(self, ring, ingester_clients: dict, overrides,
                  generator_ring=None, generator_clients: dict | None = None,
-                 instance_id: str = "distributor-0"):
+                 forwarder_manager=None, instance_id: str = "distributor-0"):
         """ingester_clients: instance_id -> object with
         push_segment(tenant, data: bytes)."""
         self.ring = ring
@@ -71,6 +72,7 @@ class Distributor:
         self.overrides = overrides
         self.generator_ring = generator_ring
         self.generator_clients = generator_clients or {}
+        self.forwarder_manager = forwarder_manager
         self.instance_id = instance_id
         self.metrics = DistributorMetrics()
         self._limiters: dict[str, TokenBucket] = {}
@@ -94,10 +96,19 @@ class Distributor:
     def push_traces(self, tenant: str, traces) -> None:
         """Object-form entry (receiver boundary)."""
         self.push_batch(tenant, traces_to_batch(traces))
+        # async tee to per-tenant external forwarders (reference:
+        # generatorForwarder/SendTraces + forwarder Manager, after the
+        # ingester write has been accepted)
+        if self.forwarder_manager is not None:
+            self.forwarder_manager.send(tenant, traces)
 
     def push_batch(self, tenant: str, batch: SpanBatch) -> None:
         if batch.num_spans == 0:
             return
+        with tracing.span("distributor.PushBatch", tenant=tenant, spans=batch.num_spans):
+            self._push_batch_traced(tenant, batch)
+
+    def _push_batch_traced(self, tenant: str, batch: SpanBatch) -> None:
         size = batch.nbytes()
         if not self._limiter(tenant).allow_n(size):
             self.metrics.traces_rate_limited[tenant] = (

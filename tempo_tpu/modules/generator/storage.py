@@ -30,15 +30,15 @@ from tempo_tpu.receivers.protowire import (
     put_varint_field,
 )
 from tempo_tpu.util import snappy
-from tempo_tpu.util.metrics import Counter
+from tempo_tpu.util import metrics
 
 log = logging.getLogger(__name__)
 
-remote_write_samples = Counter(
+remote_write_samples = metrics.counter(
     "tempo_metrics_generator_storage_samples_sent_total",
     "Samples shipped via remote write",
 )
-remote_write_failures = Counter(
+remote_write_failures = metrics.counter(
     "tempo_metrics_generator_storage_send_failures_total",
     "Remote-write sends that exhausted retries",
 )

@@ -23,6 +23,7 @@ import numpy as np
 from tempo_tpu.encoding.vtpu import format as fmt
 from tempo_tpu.model.columnar import SpanBatch
 from tempo_tpu.model.trace import Trace, batch_to_traces, combine_traces
+from tempo_tpu.util.flushqueues import ExclusiveQueues, FlushOp
 
 log = logging.getLogger(__name__)
 
@@ -51,6 +52,11 @@ class IngesterConfig:
     max_block_bytes: int = 500 * 1024 * 1024
     complete_block_timeout_s: float = 900.0  # keep flushed blocks queryable
     flush_check_period_s: float = 10.0
+    # flush-queue machinery (reference: flush.go maxCompleteAttempts,
+    # flushBackoff, cfg.ConcurrentFlushes)
+    concurrent_flushes: int = 4
+    flush_backoff_s: float = 30.0
+    max_complete_attempts: int = 3
 
 
 class TenantInstance:
@@ -64,6 +70,7 @@ class TenantInstance:
         self.head = db.wal.new_block(tenant)
         self.head_created = time.time()
         self.completing: list = []  # wal blocks cut from head
+        self._inflight: set = set()  # block ids being completed right now
         self.flushed: list = []  # (meta, flushed_at) — cleared after timeout
         self.traces_created = 0
         self.spans_dropped_too_large = 0
@@ -150,30 +157,69 @@ class TenantInstance:
             self.head_created = now
             return blk
 
+    def complete_one(self, blk, now: float | None = None):
+        """One completing WAL block -> backend block; the WAL dir is
+        removed only after the backend write succeeded, so there is no
+        window where the data is visible nowhere (reference:
+        CompleteBlock:308 + handleFlush flush.go:297; single op here
+        because the write already lands in the object store).
+
+        Claim-guarded: the synchronous drain (sweep immediate /
+        flush_all) and the flush-queue workers can both reach the same
+        block; whoever claims it first completes it, the other returns
+        None (a double write_wal_block after clear() would overwrite the
+        good backend block with an empty one)."""
+        now = now or time.time()
+        with self.lock:
+            if blk.block_id in self._inflight or blk not in self.completing:
+                return None
+            self._inflight.add(blk.block_id)
+        try:
+            meta = self.db.write_wal_block(self.tenant, blk, block_id=blk.block_id)
+        except BaseException:
+            with self.lock:
+                self._inflight.discard(blk.block_id)
+            raise
+        with self.lock:
+            self._inflight.discard(blk.block_id)
+            if blk in self.completing:
+                self.completing.remove(blk)
+            if meta is not None:
+                self.flushed.append((meta, now))
+        blk.clear()
+        return meta
+
+    def drop_block(self, blk) -> None:
+        """Data-loss cap: after max_complete_attempts the block is
+        abandoned with a loud log (reference: flush.go:254-262)."""
+        log.error(
+            "DROPPING wal block %s for tenant %s after repeated complete failures — "
+            "its traces are lost",
+            blk.block_id,
+            self.tenant,
+        )
+        with self.lock:
+            self._inflight.discard(blk.block_id)
+            if blk in self.completing:
+                self.completing.remove(blk)
+        try:
+            blk.clear()
+        except Exception:
+            log.exception("clearing dropped block %s failed", blk.block_id)
+
     def complete_and_flush(self, now: float | None = None) -> list:
-        """Completing WAL blocks -> backend blocks; WAL dirs removed
-        after a successful write (reference: CompleteBlock:308 +
-        handleFlush flush.go:297; single-step here because the write
-        already lands in the object store)."""
+        """Synchronous drain of all completing blocks (deterministic
+        test/shutdown path; the background path goes through the
+        flush queues)."""
         now = now or time.time()
         out = []
         with self.lock:
             todo = list(self.completing)
         for blk in todo:
             try:
-                meta = self.db.write_wal_block(self.tenant, blk, block_id=blk.block_id)
-                # block stays in `completing` (queryable) until the backend
-                # write has succeeded and the blocklist knows about it —
-                # only then does the WAL copy disappear, so there is no
-                # window where the data is visible nowhere
-                with self.lock:
-                    if blk in self.completing:
-                        self.completing.remove(blk)
-                    if meta is not None:
-                        self.flushed.append((meta, now))
+                meta = self.complete_one(blk, now)
                 if meta is not None:
                     out.append(meta)
-                blk.clear()
             except Exception:
                 log.exception("complete/flush failed for %s; will retry", blk.block_id)
         return out
@@ -227,6 +273,9 @@ class Ingester:
         self.lock = threading.Lock()
         self._stop = threading.Event()
         self._loop_thread = None
+        self._flush_threads: list[threading.Thread] = []
+        self.flush_queues = ExclusiveQueues(self.cfg.concurrent_flushes)
+        self.blocks_dropped = 0
         self.replay()
 
     def instance(self, tenant: str) -> TenantInstance:
@@ -263,14 +312,60 @@ class Ingester:
 
     def sweep(self, immediate: bool = False) -> None:
         """One maintenance pass over all instances (reference:
-        sweepAllInstances flush.go:144)."""
+        sweepAllInstances flush.go:144). immediate=True is the
+        deterministic path: cuts everything and drains synchronously.
+        The background loop instead enqueues flush ops serviced by the
+        flush-queue workers (dedupe by block, retry with backoff)."""
         with self.lock:
             instances = list(self.instances.values())
         for inst in instances:
             inst.cut_complete_traces(immediate=immediate)
             inst.cut_block_if_ready(immediate=immediate)
-            inst.complete_and_flush()
+            if immediate or not self._flush_threads:
+                inst.complete_and_flush()
+            else:
+                self._enqueue_flush_ops(inst)
             inst.clear_flushed_blocks()
+
+    def _enqueue_flush_ops(self, inst: TenantInstance) -> None:
+        with inst.lock:
+            todo = list(inst.completing)
+        for blk in todo:
+            self.flush_queues.enqueue(
+                FlushOp(
+                    at=time.time(),
+                    seq=0,
+                    key=f"{inst.tenant}:{blk.block_id}",
+                    kind="complete",
+                    payload=(inst, blk),
+                )
+            )
+
+    def _flush_worker(self, queue) -> None:
+        """One flush-queue loop (reference: flushLoop flush.go:185)."""
+        while True:
+            op = queue.dequeue()
+            if op is None:
+                return
+            inst, blk = op.payload
+            try:
+                inst.complete_one(blk)
+                queue.clear_key(op.key)
+            except Exception:
+                op.attempts += 1
+                if op.attempts >= self.cfg.max_complete_attempts:
+                    log.exception("complete failed %d times", op.attempts)
+                    inst.drop_block(blk)
+                    self.blocks_dropped += 1
+                    queue.clear_key(op.key)
+                else:
+                    log.exception(
+                        "complete failed (attempt %d/%d); backing off",
+                        op.attempts,
+                        self.cfg.max_complete_attempts,
+                    )
+                    op.at = time.time() + self.cfg.flush_backoff_s
+                    queue.requeue(op)
 
     def flush_all(self) -> None:
         """Graceful-shutdown drain (reference: /shutdown flush.go:91)."""
@@ -279,6 +374,12 @@ class Ingester:
     def start_loop(self) -> None:
         if self._loop_thread:
             return
+        for i, q in enumerate(self.flush_queues.queues):
+            t = threading.Thread(
+                target=self._flush_worker, args=(q,), daemon=True, name=f"flush-{i}"
+            )
+            t.start()
+            self._flush_threads.append(t)
 
         def loop():
             while not self._stop.wait(self.cfg.flush_check_period_s):
@@ -294,5 +395,9 @@ class Ingester:
         self._stop.set()
         if self._loop_thread:
             self._loop_thread.join(timeout=5)
+        self.flush_queues.close()
+        for t in self._flush_threads:
+            t.join(timeout=5)
+        self._flush_threads = []
         if flush:
             self.flush_all()

@@ -180,6 +180,21 @@ class Registry:
             lines.extend(m.expose())
         return "\n".join(lines) + "\n"
 
+    def snapshot_totals(self) -> dict:
+        """name -> total across label sets, for counters and gauges
+        (feeds the usage-stats report; reference: pkg/usagestats
+        stats.go typed registry snapshot)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out: dict[str, float] = {}
+        for m in metrics:
+            values = getattr(m, "_values", None)
+            if values is None:
+                continue
+            with m._lock:
+                out[m.name] = float(sum(values.values()))
+        return out
+
 
 REGISTRY = Registry()
 
@@ -187,3 +202,4 @@ counter = REGISTRY.counter
 gauge = REGISTRY.gauge
 histogram = REGISTRY.histogram
 expose = REGISTRY.expose
+snapshot_totals = REGISTRY.snapshot_totals

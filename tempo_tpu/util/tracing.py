@@ -1,0 +1,130 @@
+"""Self-tracing — the framework traces its own request paths.
+
+Reference: every component opens OpenTracing/OTel spans over itself
+(distributor.go:289, tempodb.go:276, flush.go:298); cmd/tempo/main.go
+installs a Jaeger or OTel tracer (installOpenTelemetryTracer
+main.go:212) and pkg/util/spanlogger fuses spans with log lines.
+
+Here: a contextvars-based tracer producing the SAME span model the
+engine stores, so a deployment can export its own spans into its own
+ingest path (the dogfooding the reference gets by pointing its Jaeger
+client at itself) or into any callback.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import logging
+import os
+import threading
+import time
+
+from tempo_tpu.model.trace import KIND_INTERNAL, STATUS_ERROR, STATUS_OK, Span, Trace
+
+_current_span: contextvars.ContextVar = contextvars.ContextVar("tempo_current_span", default=None)
+
+
+def _rand_bytes(n: int) -> bytes:
+    return os.urandom(n)
+
+
+class Tracer:
+    """Minimal in-process tracer. Spans finish into `exporter(span_list)`
+    per trace root; a None exporter disables all recording at ~zero
+    cost (the default, like the reference's disabled tracer)."""
+
+    def __init__(self, service_name: str = "tempo-tpu", exporter=None):
+        self.service_name = service_name
+        self.exporter = exporter
+        self._lock = threading.Lock()
+        self._open_traces: dict[bytes, list] = {}
+        # re-entrancy guard: exporting into our own ingest path must not
+        # trace the export itself, or every export spawns another trace
+        # (the reference avoids this because its jaeger client's sender
+        # is outside the instrumented surface)
+        self._exporting = threading.local()
+
+    @property
+    def enabled(self) -> bool:
+        return self.exporter is not None and not getattr(self._exporting, "on", False)
+
+    def current_trace_id(self) -> bytes | None:
+        cur = _current_span.get()
+        return cur.trace_id if cur is not None else None
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        if not self.enabled:
+            yield None
+            return
+        parent = _current_span.get()
+        trace_id = parent.trace_id if parent is not None else _rand_bytes(16)
+        s = Span(
+            trace_id=trace_id,
+            span_id=_rand_bytes(8),
+            parent_span_id=parent.span_id if parent is not None else b"\x00" * 8,
+            name=name,
+            start_unix_nano=time.time_ns(),
+            kind=KIND_INTERNAL,
+            attributes={k: v for k, v in attrs.items()},
+        )
+        token = _current_span.set(s)
+        try:
+            yield s
+            s.status_code = STATUS_OK
+        except BaseException:
+            s.status_code = STATUS_ERROR
+            raise
+        finally:
+            s.duration_nano = max(time.time_ns() - s.start_unix_nano, 1)
+            _current_span.reset(token)
+            self._finish(s, is_root=parent is None)
+
+    def _finish(self, span: Span, is_root: bool) -> None:
+        with self._lock:
+            self._open_traces.setdefault(span.trace_id, []).append(span)
+            done = self._open_traces.pop(span.trace_id) if is_root else None
+        if done:
+            trace = Trace(
+                trace_id=span.trace_id,
+                batches=[({"service.name": self.service_name}, done)],
+            )
+            self._exporting.on = True
+            try:
+                self.exporter([trace])
+            except Exception:
+                logging.getLogger(__name__).exception("span export failed")
+            finally:
+                self._exporting.on = False
+
+
+# process-global tracer, disabled by default; main/app installs an exporter
+TRACER = Tracer()
+
+
+def install_exporter(exporter, service_name: str | None = None) -> None:
+    if service_name:
+        TRACER.service_name = service_name
+    TRACER.exporter = exporter
+
+
+def span(name: str, **attrs):
+    return TRACER.span(name, **attrs)
+
+
+class SpanLogger(logging.LoggerAdapter):
+    """Log↔trace correlation: lines carry the active traceID and are
+    also recorded as span attributes (reference: pkg/util/spanlogger +
+    withSpan flush.go:287)."""
+
+    def __init__(self, logger: logging.Logger, tracer: Tracer | None = None):
+        super().__init__(logger, {})
+        self.tracer = tracer or TRACER
+
+    def process(self, msg, kwargs):
+        cur = _current_span.get()
+        if cur is not None:
+            cur.attributes.setdefault("log", []).append(str(msg))
+            msg = f"traceID={cur.trace_id.hex()} {msg}"
+        return msg, kwargs

@@ -21,13 +21,13 @@ import time
 import urllib.parse
 
 from tempo_tpu.encoding.common import SearchRequest
-from tempo_tpu.util.metrics import Counter
+from tempo_tpu.util import metrics
 from tempo_tpu.util.traceinfo import TraceInfo
 
 log = logging.getLogger(__name__)
 
-vulture_traces_written = Counter("tempo_vulture_trace_total", "Traces written by vulture")
-vulture_errors = Counter(
+vulture_traces_written = metrics.counter("tempo_vulture_trace_total", "Traces written by vulture")
+vulture_errors = metrics.counter(
     "tempo_vulture_error_total",
     "Vulture check failures by type (notfound_byid | missing_spans | "
     "notfound_search | request_failed)",

@@ -1,0 +1,318 @@
+"""Auxiliary subsystem tests: flush queues, forwarder, usage stats,
+self-tracing/spanlogger.
+
+Reference patterns: pkg/flushqueues tests, modules/distributor/forwarder
+tests, pkg/usagestats reporter tests, pkg/util/spanlogger."""
+
+import logging
+import threading
+import time
+
+import pytest
+
+from tempo_tpu.app import App, AppConfig
+from tempo_tpu.backend.mock import MockBackend
+from tempo_tpu.db import DBConfig
+from tempo_tpu.model import synth
+from tempo_tpu.modules.forwarder import Forwarder, ForwarderConfig, ForwarderManager
+from tempo_tpu.modules.overrides import Limits, Overrides
+from tempo_tpu.usagestats import Reporter, UsageStatsConfig, get_or_create_cluster_seed
+from tempo_tpu.util import tracing
+from tempo_tpu.util.flushqueues import ExclusiveQueues, FlushOp, PriorityQueue
+
+
+class TestPriorityQueue:
+    def test_dedupe_by_key(self):
+        q = PriorityQueue()
+        assert q.enqueue(FlushOp(at=0, seq=0, key="a"))
+        assert not q.enqueue(FlushOp(at=0, seq=0, key="a"))  # duplicate held
+        op = q.dequeue(timeout=0.5)
+        assert op.key == "a"
+        # key still held until cleared (op is in-flight)
+        assert not q.enqueue(FlushOp(at=0, seq=0, key="a"))
+        q.clear_key("a")
+        assert q.enqueue(FlushOp(at=0, seq=0, key="a"))
+
+    def test_priority_order_and_delay(self):
+        q = PriorityQueue()
+        now = time.time()
+        q.enqueue(FlushOp(at=now + 10, seq=0, key="later"))
+        q.enqueue(FlushOp(at=now - 1, seq=0, key="due"))
+        op = q.dequeue(timeout=0.5)
+        assert op.key == "due"
+        # "later" is not due yet
+        assert q.dequeue(timeout=0.1) is None
+
+    def test_requeue_backoff(self):
+        q = PriorityQueue()
+        q.enqueue(FlushOp(at=0, seq=0, key="x"))
+        op = q.dequeue(timeout=0.5)
+        op.attempts += 1
+        op.at = time.time() + 0.15
+        q.requeue(op)
+        assert q.dequeue(timeout=0.05) is None  # backing off
+        got = q.dequeue(timeout=1.0)
+        assert got is not None and got.attempts == 1
+
+    def test_close_unblocks(self):
+        q = PriorityQueue()
+        out = []
+        t = threading.Thread(target=lambda: out.append(q.dequeue()))
+        t.start()
+        time.sleep(0.05)
+        q.close()
+        t.join(timeout=1)
+        assert out == [None]
+
+    def test_exclusive_queues_pin_by_key(self):
+        eq = ExclusiveQueues(4)
+        for i in range(32):
+            eq.enqueue(FlushOp(at=0, seq=0, key=f"tenant:{i}"))
+        assert eq.pending() == 32
+        # same key -> same queue, dedupe still applies across the set
+        assert not eq.enqueue(FlushOp(at=0, seq=0, key="tenant:3"))
+
+
+class TestIngesterFlushQueues:
+    def test_flush_retry_then_drop(self, tmp_path):
+        """A block whose complete keeps failing is retried with backoff
+        and finally dropped (reference: flush.go:254-262)."""
+        from tempo_tpu.db import TempoDB
+        from tempo_tpu.modules.ingester import Ingester, IngesterConfig
+
+        db = TempoDB(DBConfig(backend="mock", wal_path=str(tmp_path / "wal")))
+        cfg = IngesterConfig(
+            flush_check_period_s=0.05,
+            flush_backoff_s=0.05,
+            max_complete_attempts=2,
+            concurrent_flushes=2,
+        )
+        ing = Ingester(db, Overrides(Limits()), cfg)
+        # break the backend write path
+        def boom(*a, **k):
+            raise IOError("backend down")
+
+        db.write_wal_block = boom
+        from tempo_tpu.model import trace as tr
+
+        inst = ing.instance("acme")
+        inst.push_batch(tr.traces_to_batch(synth.make_traces(5, seed=1)))
+        inst.cut_complete_traces(immediate=True)
+        inst.cut_block_if_ready(immediate=True)
+        ing.start_loop()
+        deadline = time.monotonic() + 10
+        while ing.blocks_dropped == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        ing.stop(flush=False)
+        assert ing.blocks_dropped == 1
+        assert not ing.instance("acme").completing  # dropped, not stuck
+
+
+class TestForwarder:
+    def test_tenant_opt_in_routing(self):
+        got = []
+        ov = Overrides(Limits(forwarders=("dev-null",)))
+        mgr = ForwarderManager(
+            [ForwarderConfig(name="dev-null", backend="callable")],
+            ov,
+            send_fn=lambda tenant, traces: got.append((tenant, len(traces))),
+        )
+        traces = synth.make_traces(3, seed=2)
+        mgr.send("acme", traces)
+        mgr.forwarders["dev-null"].drain()
+        time.sleep(0.05)
+        mgr.stop()
+        assert got == [("acme", 3)]
+
+    def test_tenant_without_optin_not_forwarded(self):
+        got = []
+        ov = Overrides(Limits())  # no forwarders for any tenant
+        mgr = ForwarderManager(
+            [ForwarderConfig(name="dev-null", backend="callable")],
+            ov,
+            send_fn=lambda tenant, traces: got.append(tenant),
+        )
+        mgr.send("acme", synth.make_traces(2, seed=3))
+        mgr.stop()
+        assert got == []
+
+    def test_queue_overflow_drops(self):
+        block = threading.Event()
+        f = Forwarder(
+            ForwarderConfig(name="slow", queue_size=2),
+            send_fn=lambda t, tr: block.wait(2),
+        )
+        ok = [f.enqueue("acme", [])]
+        time.sleep(0.05)  # let worker pick one up and block
+        ok += [f.enqueue("acme", []) for _ in range(3)]
+        assert not all(ok)  # at least one dropped
+        block.set()
+        f.stop()
+
+    def test_otlp_http_send(self):
+        """End-to-end over HTTP into a fake collector."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from tempo_tpu.receivers import otlp
+
+        received = []
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", 0))
+                received.append(
+                    (self.headers.get("X-Scope-OrgID"), otlp.decode_traces_request(self.rfile.read(n)))
+                )
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        f = Forwarder(
+            ForwarderConfig(
+                name="col", endpoint=f"http://127.0.0.1:{srv.server_address[1]}"
+            )
+        )
+        traces = synth.make_traces(2, seed=4)
+        f.enqueue("acme", traces)
+        deadline = time.monotonic() + 5
+        while not received and time.monotonic() < deadline:
+            time.sleep(0.02)
+        f.stop()
+        srv.shutdown()
+        assert received and received[0][0] == "acme"
+        assert {t.trace_id for t in received[0][1]} == {t.trace_id for t in traces}
+
+
+class TestUsageStats:
+    def test_cluster_seed_stable(self):
+        raw = MockBackend()
+        s1 = get_or_create_cluster_seed(raw)
+        s2 = get_or_create_cluster_seed(raw)
+        assert s1["UID"] == s2["UID"]
+
+    def test_report_shape_and_send(self):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        import json
+
+        got = []
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", 0))
+                got.append(json.loads(self.rfile.read(n)))
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        r = Reporter(
+            UsageStatsConfig(
+                enabled=True, endpoint=f"http://127.0.0.1:{srv.server_address[1]}"
+            ),
+            MockBackend(),
+            version="test",
+        )
+        r.set_stat("feature_x", True)
+        assert r.send_report()
+        srv.shutdown()
+        doc = got[0]
+        assert doc["clusterID"] and doc["version"] == "test"
+        assert doc["metrics"]["feature_x"] is True
+
+    def test_disabled_never_sends(self):
+        r = Reporter(UsageStatsConfig(enabled=False), MockBackend())
+        assert not r.send_report()
+
+
+class TestTracing:
+    def test_disabled_tracer_is_noop(self):
+        t = tracing.Tracer()
+        with t.span("op") as s:
+            assert s is None
+
+    def test_span_tree_exported_once_per_trace(self):
+        exported = []
+        t = tracing.Tracer(exporter=exported.append)
+        with t.span("root", kind="test"):
+            with t.span("child-a"):
+                pass
+            with t.span("child-b"):
+                pass
+        assert len(exported) == 1
+        trace = exported[0][0]
+        spans = list(trace.all_spans())
+        assert {s.name for s in spans} == {"root", "child-a", "child-b"}
+        root = next(s for s in spans if s.name == "root")
+        for c in spans:
+            if c.name != "root":
+                assert c.parent_span_id == root.span_id
+                assert c.trace_id == root.trace_id
+
+    def test_error_status_recorded(self):
+        exported = []
+        t = tracing.Tracer(exporter=exported.append)
+        with pytest.raises(RuntimeError):
+            with t.span("fails"):
+                raise RuntimeError("x")
+        span = list(exported[0][0].all_spans())[0]
+        from tempo_tpu.model.trace import STATUS_ERROR
+
+        assert span.status_code == STATUS_ERROR
+
+    def test_self_tracing_into_app(self, tmp_path):
+        """Dogfood: export framework spans into the framework itself."""
+        cfg = AppConfig(
+            db=DBConfig(
+                backend="local",
+                backend_path=str(tmp_path / "blocks"),
+                wal_path=str(tmp_path / "wal"),
+            ),
+            generator_enabled=False,
+        )
+        app = App(cfg)
+        try:
+            t = tracing.Tracer(
+                service_name="tempo-tpu-self",
+                exporter=lambda traces: app.push_traces(traces, org_id=None),
+            )
+            with t.span("selfcheck"):
+                pass
+            # the exported span is findable through the normal query path
+            hits = app.search_tag_values("service.name")
+            assert "tempo-tpu-self" in hits
+
+            # re-entrancy: install globally so the push path itself is
+            # instrumented; exporting must not recurse into new traces
+            tracing.install_exporter(t.exporter, "tempo-tpu-self")
+            try:
+                with tracing.span("instrumented-root"):
+                    pass
+            finally:
+                tracing.install_exporter(None)
+            assert app.search_tag_values("name")  # still alive, no recursion
+        finally:
+            app.shutdown()
+
+    def test_spanlogger_correlates(self, caplog):
+        exported = []
+        t = tracing.Tracer(exporter=exported.append)
+        sl = tracing.SpanLogger(logging.getLogger("test-sl"), t)
+        with caplog.at_level(logging.INFO, logger="test-sl"):
+            with t.span("op"):
+                sl.info("inside the span")
+        assert "traceID=" in caplog.text
+        span = list(exported[0][0].all_spans())[0]
+        assert span.attributes["log"] == ["inside the span"]

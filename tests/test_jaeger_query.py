@@ -1,0 +1,99 @@
+"""Jaeger query-bridge tests (cmd/tempo-query equivalent)."""
+
+import json
+
+import pytest
+
+from tempo_tpu.app import App, AppConfig
+from tempo_tpu.backend.httpclient import PooledHTTPClient
+from tempo_tpu.db import DBConfig
+from tempo_tpu.jaeger_query import JaegerQueryBridge, JaegerQueryServer, trace_to_jaeger
+from tempo_tpu.model import synth
+
+
+@pytest.fixture
+def app(tmp_path):
+    cfg = AppConfig(
+        db=DBConfig(
+            backend="local",
+            backend_path=str(tmp_path / "blocks"),
+            wal_path=str(tmp_path / "wal"),
+        ),
+        generator_enabled=False,
+    )
+    a = App(cfg)
+    yield a
+    a.shutdown()
+
+
+class TestConversion:
+    def test_trace_to_jaeger_shape(self):
+        t = synth.make_trace(seed=3, n_spans=8)
+        doc = trace_to_jaeger(t)
+        assert doc["traceID"] == t.trace_id.hex()
+        assert len(doc["spans"]) == 8
+        assert len(doc["processes"]) == len(t.batches)
+        span = doc["spans"][0]
+        assert {"traceID", "spanID", "operationName", "references", "startTime",
+                "duration", "tags", "logs", "processID"} <= set(span)
+        # processes carry service names; spans reference them
+        assert all(s["processID"] in doc["processes"] for s in doc["spans"])
+        assert all(p["serviceName"] for p in doc["processes"].values())
+        # micros conversion
+        root = next(s for s in doc["spans"] if not s["references"])
+        want = next(sp for sp in t.all_spans() if sp.parent_span_id == b"\x00" * 8)
+        assert root["startTime"] == want.start_unix_nano // 1000
+
+    def test_child_of_references(self):
+        t = synth.make_trace(seed=4, n_spans=6)
+        doc = trace_to_jaeger(t)
+        roots = [s for s in doc["spans"] if not s["references"]]
+        children = [s for s in doc["spans"] if s["references"]]
+        assert len(roots) == 1 and len(children) == 5
+        span_ids = {s["spanID"] for s in doc["spans"]}
+        for c in children:
+            assert c["references"][0]["refType"] == "CHILD_OF"
+            assert c["references"][0]["spanID"] in span_ids
+
+
+class TestBridge:
+    def test_get_trace_and_find(self, app):
+        traces = synth.make_traces(10, seed=6)
+        app.push_traces(traces)
+        bridge = JaegerQueryBridge(app)
+        doc = bridge.get_trace(traces[2].trace_id.hex())
+        assert doc is not None and len(doc["spans"]) == traces[2].span_count()
+        assert bridge.get_trace("deadbeef" * 4) is None
+        svc = traces[3].batches[0][0]["service.name"]
+        hits = bridge.find_traces({"service": svc, "limit": "50"})
+        assert traces[3].trace_id.hex() in {h["traceID"] for h in hits}
+
+    def test_services_and_operations(self, app):
+        traces = synth.make_traces(10, seed=8)
+        app.push_traces(traces)
+        bridge = JaegerQueryBridge(app)
+        want_services = {r["service.name"] for t in traces for r, _ in t.batches}
+        assert want_services <= set(bridge.get_services())
+        ops = bridge.get_operations("any")
+        assert set(ops) & {s.name for t in traces for s in t.all_spans()}
+
+
+class TestServer:
+    def test_http_roundtrip(self, app):
+        traces = synth.make_traces(8, seed=9)
+        app.push_traces(traces)
+        srv = JaegerQueryServer(JaegerQueryBridge(app)).start()
+        try:
+            c = PooledHTTPClient(srv.url)
+            _, body, _ = c.request("GET", "/api/services")
+            assert json.loads(body)["data"]
+            _, body, _ = c.request("GET", f"/api/traces/{traces[0].trace_id.hex()}")
+            doc = json.loads(body)
+            assert doc["data"][0]["traceID"] == traces[0].trace_id.hex()
+            svc = traces[1].batches[0][0]["service.name"]
+            _, body, _ = c.request("GET", f"/api/traces?service={svc}&limit=50")
+            assert traces[1].trace_id.hex() in {t["traceID"] for t in json.loads(body)["data"]}
+            status, _, _ = c.request("GET", "/api/traces/ffffffffffffffffffffffffffffffff", ok=(404,))
+            assert status == 404
+        finally:
+            srv.stop()
