@@ -1,0 +1,74 @@
+"""Process entry point: `python -m tempo_tpu -config.file=tempo.yaml`.
+
+Reference: cmd/tempo/main.go — flags + YAML config (envsubst), tracer
+install, config sanity warnings, then app.New(cfg).Run(). The
+single-binary `-target=all` composition runs every role in-process;
+`-config.verify` (reference: -config.verify) validates and exits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+from tempo_tpu.api.server import TempoServer
+from tempo_tpu.app import App
+from tempo_tpu.config import Config, check_config, load_config
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tempo-tpu")
+    p.add_argument("-config.file", dest="config_file", default="", help="YAML config path")
+    p.add_argument("-config.verify", dest="verify", action="store_true",
+                   help="validate config and exit")
+    p.add_argument("-target", dest="target", default="",
+                   help="role to run (all | distributor | ... ; overrides config)")
+    p.add_argument("-server.http-listen-port", dest="port", type=int, default=0)
+    args = p.parse_args(argv)
+
+    cfg = load_config(args.config_file) if args.config_file else Config()
+    if args.target:
+        cfg.target = args.target
+    if args.port:
+        cfg.server.http_listen_port = args.port
+
+    logging.basicConfig(
+        level=getattr(logging, cfg.server.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    log = logging.getLogger("tempo_tpu")
+
+    for w in check_config(cfg):
+        log.warning("config check: %s", w)
+    if args.verify:
+        print("config ok")
+        return 0
+
+    cfg.app.target = cfg.target
+    app = App(cfg.app)
+    server = TempoServer(
+        app, host=cfg.server.http_listen_address, port=cfg.server.http_listen_port
+    ).start()
+    app.start_loops()
+    log.info("tempo-tpu up: target=%s listening on %s", cfg.target, server.url)
+
+    stop = threading.Event()
+
+    def handle(sig, frame):
+        log.info("signal %s: shutting down", sig)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, handle)
+    signal.signal(signal.SIGINT, handle)
+    stop.wait()
+    server.stop()
+    app.shutdown()
+    log.info("tempo-tpu stopped cleanly")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,210 @@
+"""Config tree: YAML + env expansion + validation warnings.
+
+Reference: cmd/tempo/app/config.go — one Config struct embedding every
+module's config (config.go:29-51), populated defaults → YAML
+(`-config.file`, with `${VAR}` envsubst expansion done by
+cmd/tempo/main.go loadConfig) → flags; `CheckConfig` emits structured
+warnings for footguns (config.go:125-170). YAML keys here mirror the
+reference's section names (server, distributor, ingester, storage,
+compactor, querier, query_frontend, metrics_generator, overrides,
+usage_report) so a Tempo operator's mental model carries over.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import re
+from dataclasses import dataclass, field
+
+import yaml
+
+from tempo_tpu.app import AppConfig
+from tempo_tpu.db import DBConfig
+from tempo_tpu.db.compaction import CompactionConfig
+from tempo_tpu.encoding.common import BlockConfig
+from tempo_tpu.modules.forwarder import ForwarderConfig
+from tempo_tpu.modules.frontend import FrontendConfig
+from tempo_tpu.modules.generator.storage import RemoteWriteConfig
+from tempo_tpu.modules.ingester import IngesterConfig
+from tempo_tpu.modules.overrides import Limits
+from tempo_tpu.usagestats import UsageStatsConfig
+
+log = logging.getLogger(__name__)
+
+_ENV_RE = re.compile(r"\$\{(\w+)(?::([^}]*))?\}")
+
+
+@dataclass
+class ServerConfig:
+    http_listen_address: str = "127.0.0.1"
+    http_listen_port: int = 3200
+    log_level: str = "info"
+
+
+@dataclass
+class Config:
+    """Top-level process config (reference: app.Config)."""
+
+    target: str = "all"
+    server: ServerConfig = field(default_factory=ServerConfig)
+    app: AppConfig = field(default_factory=AppConfig)
+
+
+def expand_env(text: str, env: dict | None = None) -> str:
+    """${VAR} / ${VAR:default} substitution (reference: main.go envsubst
+    via drone/envsubst)."""
+    env = os.environ if env is None else env
+
+    def sub(m: re.Match) -> str:
+        return env.get(m.group(1), m.group(2) if m.group(2) is not None else "")
+
+    return _ENV_RE.sub(sub, text)
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def _from_dict(cls, doc: dict, path: str = ""):
+    """Populate dataclass `cls` from a plain dict, strictly: unknown
+    keys are errors (the reference's strict-YAML option, on by default
+    here — silent typos in storage config are how data gets lost)."""
+    if doc is None:
+        return cls()
+    if not isinstance(doc, dict):
+        raise ConfigError(f"{path or cls.__name__}: expected a mapping, got {type(doc).__name__}")
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    kwargs = {}
+    for key, value in doc.items():
+        f = fields.get(key)
+        if f is None:
+            raise ConfigError(
+                f"{path + '.' if path else ''}{key}: unknown config key for {cls.__name__}"
+            )
+        sub_path = f"{path + '.' if path else ''}{key}"
+        if dataclasses.is_dataclass(f.type) or (
+            isinstance(f.default_factory, type) and dataclasses.is_dataclass(f.default_factory)
+        ):
+            target = f.default_factory if isinstance(f.default_factory, type) else f.type
+            kwargs[key] = _from_dict(target, value, sub_path)
+        elif isinstance(value, dict) and f.default_factory is not dataclasses.MISSING:
+            probe = f.default_factory()
+            if dataclasses.is_dataclass(probe):
+                kwargs[key] = _from_dict(type(probe), value, sub_path)
+            else:
+                kwargs[key] = value
+        else:
+            kwargs[key] = tuple(value) if isinstance(value, list) and _wants_tuple(f) else value
+    return cls(**kwargs)
+
+
+def _wants_tuple(f) -> bool:
+    if f.default is not dataclasses.MISSING and isinstance(f.default, tuple):
+        return True
+    if f.default_factory is not dataclasses.MISSING:
+        try:
+            return isinstance(f.default_factory(), tuple)
+        except Exception:
+            return False
+    return False
+
+
+def parse_config(text: str, env: dict | None = None) -> Config:
+    doc = yaml.safe_load(expand_env(text, env)) or {}
+    if not isinstance(doc, dict):
+        raise ConfigError("config root must be a mapping")
+
+    cfg = Config()
+    cfg.target = doc.pop("target", cfg.target)
+    cfg.server = _from_dict(ServerConfig, doc.pop("server", None), "server")
+
+    app_doc: dict = {}
+    # reference section names -> AppConfig fields
+    app_doc["multitenancy_enabled"] = doc.pop("multitenancy_enabled", False)
+    storage = doc.pop("storage", {}) or {}
+    trace = storage.pop("trace", {}) or {}
+    if storage:
+        raise ConfigError(f"storage.{next(iter(storage))}: unknown config key")
+    app = AppConfig()
+    app.multitenancy_enabled = bool(app_doc["multitenancy_enabled"])
+    app.db = _from_dict(DBConfig, trace, "storage.trace")
+    app.ingester = _from_dict(IngesterConfig, doc.pop("ingester", None), "ingester")
+    app.frontend = _from_dict(FrontendConfig, doc.pop("query_frontend", None), "query_frontend")
+
+    overrides_doc = doc.pop("overrides", {}) or {}
+    app.overrides_path = overrides_doc.pop("per_tenant_override_config", None)
+    app.limits = _from_dict(Limits, overrides_doc.pop("defaults", None), "overrides.defaults")
+    if overrides_doc:
+        raise ConfigError(f"overrides.{next(iter(overrides_doc))}: unknown config key")
+
+    dist = doc.pop("distributor", {}) or {}
+    fwd_list = dist.pop("forwarders", []) or []
+    app.forwarders = [
+        _from_dict(ForwarderConfig, f, f"distributor.forwarders[{i}]")
+        for i, f in enumerate(fwd_list)
+    ]
+    if dist:
+        raise ConfigError(f"distributor.{next(iter(dist))}: unknown config key")
+
+    gen = doc.pop("metrics_generator", {}) or {}
+    app.generator_enabled = bool(gen.pop("enabled", True))
+    rw = gen.pop("remote_write", None)
+    if rw:
+        app.remote_write = _from_dict(
+            RemoteWriteConfig, rw, "metrics_generator.remote_write"
+        )
+    if gen:
+        raise ConfigError(f"metrics_generator.{next(iter(gen))}: unknown config key")
+
+    app.usage_stats = _from_dict(UsageStatsConfig, doc.pop("usage_report", None), "usage_report")
+
+    for key in ("replication_factor", "n_ingesters", "query_workers"):
+        if key in doc:
+            setattr(app, key, int(doc.pop(key)))
+
+    if doc:
+        raise ConfigError(f"{next(iter(doc))}: unknown top-level config key")
+    cfg.app = app
+    return cfg
+
+
+def load_config(path: str, env: dict | None = None) -> Config:
+    with open(path) as f:
+        return parse_config(f.read(), env)
+
+
+def check_config(cfg: Config) -> list[str]:
+    """Footgun warnings (reference: CheckConfig config.go:125-170) —
+    never fatal, always loud."""
+    warnings = []
+    app = cfg.app
+    if app.replication_factor > app.n_ingesters:
+        warnings.append(
+            f"replication_factor ({app.replication_factor}) > n_ingesters "
+            f"({app.n_ingesters}): every push will fail quorum"
+        )
+    if app.db.backend in ("s3", "gcs", "azure") and app.db.cache == "none":
+        warnings.append(
+            "cloud backend without a cache: every bloom test pays an object-store round trip"
+        )
+    if app.db.block.bloom_fp > 0.05:
+        warnings.append(
+            f"bloom_fp {app.db.block.bloom_fp} is high; trace-by-ID will touch many blocks"
+        )
+    if app.limits.block_retention_s and (
+        app.limits.block_retention_s < app.db.compaction.window_s
+    ):
+        warnings.append(
+            "per-tenant retention is shorter than the compaction window: "
+            "blocks may be deleted before ever being compacted"
+        )
+    if app.ingester.complete_block_timeout_s < app.db.blocklist_poll_s:
+        warnings.append(
+            "ingester.complete_block_timeout_s < storage.trace.blocklist_poll_s: "
+            "queriers may miss traces between ingester handoff and blocklist poll"
+        )
+    if app.remote_write is not None and app.remote_write.endpoint and not app.generator_enabled:
+        warnings.append("metrics_generator.remote_write set but the generator is disabled")
+    return warnings
