@@ -11,13 +11,14 @@ interchange encoding) can plug in beside it.
 
 from __future__ import annotations
 
-from tempo_tpu.encoding import vtpu
+from tempo_tpu.encoding import vrow, vtpu
 from tempo_tpu.encoding.common import BlockConfig, SearchRequest  # noqa: F401
 
 DEFAULT_ENCODING = "vtpu1"
 
 _REGISTRY = {
     vtpu.VERSION: vtpu.Encoding(),
+    vrow.VERSION: vrow.Encoding(),
 }
 
 

@@ -1,0 +1,129 @@
+"""vrow1 on-disk format: records -> pages -> data object + page index.
+
+Reference: tempodb/encoding/v2 object.go (varint id+len records),
+page.go / page_header.go (CRC'd pages), index_writer.go /
+index_reader.go (downsampled ID index: one entry per page with the
+id range, enabling binary search). A record's payload is a serialized
+single-trace SpanBatch (the same segment format the distributor ships),
+so record decode reuses the columnar codec.
+
+Page layout:  u32 crc32(comp_body) | u32 comp_len | u32 raw_len | comp_body
+Record layout (inside a raw page): 16B trace id | u32 len | payload
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+
+import numpy as np
+
+from tempo_tpu.encoding.vtpu import format as vfmt
+
+_PAGE_HDR = struct.Struct("<III")
+_REC_HDR = struct.Struct("<16sI")
+
+
+class CorruptPage(ValueError):
+    pass
+
+
+def encode_record(trace_id: bytes, payload: bytes) -> bytes:
+    return _REC_HDR.pack(trace_id, len(payload)) + payload
+
+
+def iter_records(raw_page: bytes):
+    pos = 0
+    n = len(raw_page)
+    while pos < n:
+        if pos + _REC_HDR.size > n:
+            raise CorruptPage("truncated record header")
+        tid, ln = _REC_HDR.unpack_from(raw_page, pos)
+        pos += _REC_HDR.size
+        if pos + ln > n:
+            raise CorruptPage("truncated record payload")
+        yield tid, raw_page[pos : pos + ln]
+        pos += ln
+
+
+def encode_page(records: list[bytes]) -> bytes:
+    raw = b"".join(records)
+    comp = zlib.compress(raw, 6)
+    return _PAGE_HDR.pack(zlib.crc32(comp), len(comp), len(raw)) + comp
+
+
+def decode_page(buf: bytes) -> bytes:
+    if len(buf) < _PAGE_HDR.size:
+        raise CorruptPage("short page header")
+    crc, comp_len, raw_len = _PAGE_HDR.unpack_from(buf, 0)
+    body = buf[_PAGE_HDR.size : _PAGE_HDR.size + comp_len]
+    if len(body) != comp_len:
+        raise CorruptPage("truncated page body")
+    if zlib.crc32(body) != crc:
+        raise CorruptPage("page crc mismatch")
+    raw = zlib.decompress(body)
+    if len(raw) != raw_len:
+        raise CorruptPage("page raw length mismatch")
+    return raw
+
+
+class PageEntry:
+    """One downsampled index entry (reference: v2 Record types.go:13)."""
+
+    __slots__ = ("min_id", "max_id", "offset", "length", "n_records", "start_s", "end_s")
+
+    def __init__(self, min_id="", max_id="", offset=0, length=0, n_records=0,
+                 start_s=0, end_s=0):
+        self.min_id = min_id
+        self.max_id = max_id
+        self.offset = offset
+        self.length = length
+        self.n_records = n_records
+        self.start_s = start_s
+        self.end_s = end_s
+
+    def to_dict(self):
+        return {s: getattr(self, s) for s in self.__slots__}
+
+
+class PageIndex:
+    def __init__(self, pages: list[PageEntry] | None = None):
+        self.pages = pages or []
+
+    def to_bytes(self) -> bytes:
+        return json.dumps({"pages": [p.to_dict() for p in self.pages]}).encode()
+
+    @staticmethod
+    def from_bytes(raw: bytes) -> "PageIndex":
+        doc = json.loads(raw)
+        return PageIndex([PageEntry(**p) for p in doc["pages"]])
+
+    def find_pages(self, hex_id: str) -> list[int]:
+        """Binary search for pages whose [min_id, max_id] covers hex_id
+        (reference: v2 finder_paged.go:14)."""
+        pages = self.pages
+        lo, hi = 0, len(pages)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if pages[mid].max_id < hex_id:
+                lo = mid + 1
+            else:
+                hi = mid
+        out = []
+        while lo < len(pages) and pages[lo].min_id <= hex_id:
+            if pages[lo].max_id >= hex_id:
+                out.append(lo)
+            lo += 1
+        return out
+
+
+def trace_record(batch, lo: int, hi: int) -> tuple[bytes, bytes]:
+    """Rows [lo, hi) of a trace-sorted batch (one trace) -> record."""
+    sub = batch.select(np.arange(lo, hi))
+    tid = batch.cols["trace_id"][lo].astype(">u4").tobytes()
+    return tid, encode_record(tid, vfmt.serialize_batch(sub))
+
+
+def decode_record_payload(payload: bytes):
+    return vfmt.deserialize_batch(payload)
