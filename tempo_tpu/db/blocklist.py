@@ -20,6 +20,7 @@ from tempo_tpu.backend.base import (
     NotFound,
     TypedBackend,
 )
+from tempo_tpu.util import metrics
 from tempo_tpu.backend.tenantindex import (
     TenantIndex,
     is_stale,
@@ -28,6 +29,10 @@ from tempo_tpu.backend.tenantindex import (
 )
 
 log = logging.getLogger(__name__)
+
+blocklist_length = metrics.gauge(
+    "tempodb_blocklist_length", "Current blocklist length per tenant"
+)
 
 
 class Blocklist:
@@ -58,6 +63,8 @@ class Blocklist:
         with self._lock:
             self._metas = {t: list(v) for t, v in metas.items()}
             self._compacted = {t: list(v) for t, v in compacted.items()}
+            for t, v in self._metas.items():
+                blocklist_length.set(len(v), tenant=t)
 
     def update(self, tenant, adds=(), removes=(), compacted_adds=()):
         """In-flight reconciliation between polls: the compactor updates
@@ -72,6 +79,7 @@ class Blocklist:
             cc = self._compacted.setdefault(tenant, [])
             have_c = {c.meta.block_id for c in cc}
             cc.extend(c for c in compacted_adds if c.meta.block_id not in have_c)
+            blocklist_length.set(len(cur), tenant=tenant)
 
     def drop_compacted(self, tenant, block_ids):
         """Forget compacted entries whose objects were cleared (retention

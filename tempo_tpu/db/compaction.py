@@ -15,8 +15,22 @@ import time
 from dataclasses import dataclass, field
 
 from tempo_tpu.backend.base import BlockMeta, CompactedBlockMeta
+from tempo_tpu.util import metrics
 
 log = logging.getLogger(__name__)
+
+compaction_runs = metrics.counter(
+    "tempodb_compaction_runs_total", "Compaction jobs executed"
+)
+compaction_errors = metrics.counter(
+    "tempodb_compaction_errors_total", "Compaction jobs that failed"
+)
+compaction_blocks = metrics.counter(
+    "tempodb_compaction_blocks_compacted_total", "Input blocks consumed by compaction"
+)
+compaction_objects = metrics.counter(
+    "tempodb_compaction_objects_written_total", "Objects (traces) written by compaction"
+)
 
 DEFAULT_INPUT_BLOCKS = 2  # reference: tempodb/compactor.go:21-23
 MAX_COMPACTION_RANGE = 4
@@ -135,6 +149,7 @@ class CompactionDriver:
                 jobs += 1
             except Exception:
                 self.metrics.errors += 1
+                compaction_errors.inc(tenant=tenant)
                 log.exception("compaction job %s failed", job_hash)
             if max_jobs and jobs >= max_jobs:
                 break
@@ -151,6 +166,9 @@ class CompactionDriver:
             compacted.append(CompactedBlockMeta(meta=m, compacted_time=now))
         self.db.blocklist.update(tenant, adds=new_metas, removes=group, compacted_adds=compacted)
         self.metrics.jobs += 1
+        compaction_runs.inc(tenant=tenant)
+        compaction_blocks.inc(len(group), tenant=tenant)
+        compaction_objects.inc(sum(m.total_objects for m in new_metas), tenant=tenant)
         self.metrics.blocks_in += len(group)
         self.metrics.blocks_out += len(new_metas)
         self.metrics.objects_written += sum(m.total_objects for m in new_metas)

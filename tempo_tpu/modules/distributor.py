@@ -21,9 +21,19 @@ from tempo_tpu.encoding.vtpu import format as fmt
 from tempo_tpu.model.columnar import SpanBatch
 from tempo_tpu.model.trace import traces_to_batch
 from tempo_tpu.ops import hashing
-from tempo_tpu.util import tracing
+from tempo_tpu.util import metrics, tracing
 
 log = logging.getLogger(__name__)
+
+spans_received = metrics.counter(
+    "tempo_distributor_spans_received_total", "Spans accepted by the distributor"
+)
+bytes_received = metrics.counter(
+    "tempo_distributor_bytes_received_total", "Bytes accepted by the distributor"
+)
+discarded_spans = metrics.counter(
+    "tempo_discarded_spans_total", "Spans discarded at ingest, by reason"
+)
 
 
 class RateLimited(Exception):
@@ -114,11 +124,14 @@ class Distributor:
             self.metrics.traces_rate_limited[tenant] = (
                 self.metrics.traces_rate_limited.get(tenant, 0) + 1
             )
+            discarded_spans.inc(batch.num_spans, reason="rate_limited", tenant=tenant)
             raise RateLimited(f"tenant {tenant}: ingestion rate limit exceeded")
         self.metrics.spans_received[tenant] = (
             self.metrics.spans_received.get(tenant, 0) + batch.num_spans
         )
         self.metrics.bytes_received[tenant] = self.metrics.bytes_received.get(tenant, 0) + size
+        spans_received.inc(batch.num_spans, tenant=tenant)
+        bytes_received.inc(size, tenant=tenant)
 
         groups = self._group_by_replica(tenant, batch)
         if not groups:

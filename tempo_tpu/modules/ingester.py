@@ -23,9 +23,21 @@ import numpy as np
 from tempo_tpu.encoding.vtpu import format as fmt
 from tempo_tpu.model.columnar import SpanBatch
 from tempo_tpu.model.trace import Trace, batch_to_traces, combine_traces
+from tempo_tpu.util import metrics
 from tempo_tpu.util.flushqueues import ExclusiveQueues, FlushOp
 
 log = logging.getLogger(__name__)
+
+blocks_flushed = metrics.counter(
+    "tempo_ingester_blocks_flushed_total", "WAL blocks completed and written to the backend"
+)
+blocks_dropped_metric = metrics.counter(
+    "tempo_ingester_blocks_dropped_total",
+    "WAL blocks dropped after repeated complete failures (DATA LOSS)",
+)
+live_traces_gauge = metrics.gauge(
+    "tempo_ingester_live_traces", "Live traces currently held, per tenant"
+)
 
 
 class TraceTooLarge(Exception):
@@ -121,6 +133,7 @@ class TenantInstance:
                 lt.span_count += sub.num_spans
                 lt.byte_count += sub.nbytes()
                 lt.last_touch = now
+            live_traces_gauge.set(len(self.live), tenant=self.tenant)
         if errors:
             raise errors[0]
 
@@ -134,6 +147,7 @@ class TenantInstance:
                 if immediate or now - lt.last_touch > self.cfg.max_trace_idle_s:
                     cut.append((key, lt))
                     del self.live[key]
+        live_traces_gauge.set(len(self.live), tenant=self.tenant)
         if not cut:
             return 0
         batch = SpanBatch.concat([seg for _, lt in cut for seg in lt.segments]).sorted_by_trace()
@@ -187,6 +201,8 @@ class TenantInstance:
             if meta is not None:
                 self.flushed.append((meta, now))
         blk.clear()
+        if meta is not None:
+            blocks_flushed.inc(tenant=self.tenant)
         return meta
 
     def drop_block(self, blk) -> None:
@@ -357,6 +373,7 @@ class Ingester:
                     log.exception("complete failed %d times", op.attempts)
                     inst.drop_block(blk)
                     self.blocks_dropped += 1
+                    blocks_dropped_metric.inc(tenant=inst.tenant)
                     queue.clear_key(op.key)
                 else:
                     log.exception(
