@@ -34,6 +34,7 @@ from urllib.parse import parse_qs, unquote, urlparse
 from tempo_tpu import receivers
 from tempo_tpu.api import params as api_params
 from tempo_tpu.api.params import BadRequest
+from tempo_tpu.app import RoleUnavailable
 from tempo_tpu.modules.distributor import RateLimited
 from tempo_tpu.modules.ingester import MaxLiveTraces, TraceTooLarge
 from tempo_tpu.receivers import otlp
@@ -119,6 +120,10 @@ class _Handler(BaseHTTPRequestHandler):
             return api_params.PATH_TRACES + "/{traceID}"
         if p.startswith(api_params.PATH_SEARCH_TAG_VALUES + "/") and p.endswith("/values"):
             return api_params.PATH_SEARCH_TAG_VALUES + "/{name}/values"
+        if p.startswith("/rpc/v1/worker/result/"):
+            return "/rpc/v1/worker/result/{jobID}"
+        if p.startswith("/rpc/v1/ingester/trace/"):
+            return "/rpc/v1/ingester/trace/{traceID}"
         return p
 
     def _route(self, method: str) -> None:
@@ -145,6 +150,10 @@ class _Handler(BaseHTTPRequestHandler):
             # push error translation)
             code = 429
             self._send_error(429, str(e))
+        except RoleUnavailable as e:
+            # endpoint exists but this process's target doesn't serve it
+            code = 404
+            self._send_error(404, str(e))
         except Exception:
             code = 500
             log.error("internal error on %s %s:\n%s", method, route, traceback.format_exc())
@@ -157,6 +166,18 @@ class _Handler(BaseHTTPRequestHandler):
         path = url.path.rstrip("/") or "/"
         qs = parse_qs(url.query)
         app = self.app
+
+        # inter-role RPC (reference: the gRPC services Pusher/Querier +
+        # frontend Process stream; here /rpc/v1/* on the same listener)
+        if path.startswith("/rpc/"):
+            rpc = getattr(app, "rpc", None)
+            if rpc is None:
+                self._send_error(404, "no rpc surface")
+                return 404
+            tenant = app.resolve_tenant(self._org_id())
+            code, ctype, payload = rpc.handle(method, path, tenant, self._body())
+            self._send(code, payload, ctype)
+            return code
 
         # ingest
         if method == "POST" and path in (

@@ -1,10 +1,13 @@
 """App wiring — single-binary and per-role composition.
 
 Reference: cmd/tempo/app (module manager DAG modules.go:369-423,
-target-based activation, auth middleware). The python composition is
-explicit: App(target="all") builds every role in-process sharing one
-ring + engine, which is exactly what the reference's single binary does
-(process boundaries collapse to in-process calls, SURVEY.md section 3.1).
+target-based activation, auth middleware). target="all" builds every
+role in-process sharing one ring + engine (the reference's single
+binary). Any other target builds ONE role; roles find each other
+through the shared ring KV (ring_kv_path — the FileKV stands in for
+memberlist on one host, any networked KV slots into the same 3-method
+interface) and talk over the /rpc/v1 HTTP protocol (modules/rpc.py),
+the reference's gRPC seam.
 """
 
 from __future__ import annotations
@@ -22,12 +25,28 @@ from tempo_tpu.modules.generator.storage import RemoteWriteConfig, RemoteWriteSt
 from tempo_tpu.modules.ingester import Ingester, IngesterConfig
 from tempo_tpu.modules.overrides import Limits, Overrides
 from tempo_tpu.modules.querier import Querier
-from tempo_tpu.modules.queue import RequestQueue, WorkerPool
-from tempo_tpu.modules.ring import MemoryKV, Ring
+from tempo_tpu.modules.ring import FileKV, MemoryKV, Ring
+from tempo_tpu.modules.rpc import (
+    RemoteGenerator,
+    RemoteIngester,
+    RingClientPool,
+    RPCHandler,
+)
+from tempo_tpu.modules.worker import JobBroker, LocalWorkerPool, RemoteWorker
 
 log = logging.getLogger(__name__)
 
 DEFAULT_TENANT = "single-tenant"  # reference: util.FakeTenantID for non-multitenant
+
+ROLES = (
+    "all",
+    "distributor",
+    "ingester",
+    "querier",
+    "query-frontend",
+    "compactor",
+    "metrics-generator",
+)
 
 
 @dataclass
@@ -50,18 +69,70 @@ class AppConfig:
     forwarders: list = field(default_factory=list)  # list[ForwarderConfig]
     # anonymous usage reporting (reference: pkg/usagestats; off by default)
     usage_stats: "object | None" = None  # usagestats.UsageStatsConfig
+    # -- microservices mode (any target != all) -------------------------
+    instance_id: str = ""  # this process's ring identity
+    ring_kv_path: str = ""  # shared ring state file (FileKV); role mode requires it
+    advertise_addr: str = ""  # http://host:port other roles reach us at
+    frontend_address: str = ""  # queriers: frontend to pull jobs from
+
+
+class RoleUnavailable(RuntimeError):
+    """API called on a process whose role doesn't serve it."""
 
 
 class App:
     def __init__(self, cfg: AppConfig):
         self.cfg = cfg
-        self.db = TempoDB(cfg.db)
+        target = cfg.target or "all"
+        if target not in ROLES:
+            raise ValueError(f"unknown target {target!r} (have {ROLES})")
+        self.target = target
+
+        # members default to absent; the role builder fills its slice
+        self.db = None
         self.overrides = Overrides(cfg.limits, cfg.overrides_path)
+        self.ring = None
+        self.generator_ring = None
+        self.ingesters: dict = {}
+        self.generator = None
+        self.distributor = None
+        self.querier = None
+        self.broker = None
+        self.workers = None
+        self.remote_worker = None
+        self.frontend = None
+        self.compactor = None
+        self.forwarder_manager = None
+        self.remote_write_storage = None
+        self.usage_reporter = None
+        self.rpc = None
+        self._heartbeat_stops = []
+        self._registered: list = []  # (ring, instance_id) to unregister on shutdown
+
+        if target == "all":
+            self._build_all()
+        else:
+            self._build_role(target)
+
+    # ------------------------------------------------------------------
+    def _ring_kv(self, suffix: str = ""):
+        if not self.cfg.ring_kv_path:
+            raise ValueError(f"target={self.target} requires ring_kv_path")
+        return FileKV(self.cfg.ring_kv_path + suffix)
+
+    def _instance_id(self, default: str) -> str:
+        return self.cfg.instance_id or default
+
+    def _make_db(self) -> TempoDB:
+        return TempoDB(self.cfg.db)
+
+    # ------------------------------------------------------------------
+    def _build_all(self):
+        cfg = self.cfg
+        self.db = self._make_db()
         kv = MemoryKV()
         self.ring = Ring(kv, replication_factor=cfg.replication_factor)
 
-        # ingesters
-        self.ingesters: dict[str, Ingester] = {}
         for i in range(cfg.n_ingesters):
             iid = f"ingester-{i}"
             # each in-process ingester gets its own WAL subdir (separate
@@ -73,21 +144,19 @@ class App:
             ing = Ingester(ing_db, self.overrides, cfg.ingester, instance_id=iid)
             self.ingesters[iid] = ing
             self.ring.register(iid)
+            self._registered.append((self.ring, iid))
+            self._heartbeat_stops.append(self.ring.start_heartbeat(iid))
 
-        # generator ring + instances
-        self.generator = None
-        self.remote_write_storage = None
         gen_clients = {}
-        self.generator_ring = None
         if cfg.generator_enabled:
             self.generator_ring = Ring(MemoryKV(), replication_factor=1)
             self.generator = Generator(self.overrides, instance_id="generator-0")
             self.generator_ring.register("generator-0")
             gen_clients["generator-0"] = self.generator
+            self._heartbeat_stops.append(self.generator_ring.start_heartbeat("generator-0"))
             if cfg.remote_write is not None and cfg.remote_write.endpoint:
                 self.remote_write_storage = RemoteWriteStorage(cfg.remote_write)
 
-        self.forwarder_manager = None
         if cfg.forwarders:
             from tempo_tpu.modules.forwarder import ForwarderManager
 
@@ -102,22 +171,101 @@ class App:
             forwarder_manager=self.forwarder_manager,
         )
         self.querier = Querier(self.db, self.ring, ingester_clients=self.ingesters)
-        self.queue = RequestQueue()
-        self.workers = WorkerPool(self.queue, n_workers=cfg.query_workers)
-        self.frontend = Frontend(self.queue, self.querier, cfg.frontend, self.overrides)
+        self.broker = JobBroker()
+        self.workers = LocalWorkerPool(self.broker, self.querier, cfg.query_workers)
+        self.frontend = Frontend(self.broker, self.db, cfg.frontend, self.overrides)
         self.compactor = CompactorModule(self.db, ring=None)
+        self.rpc = RPCHandler(
+            ingester=next(iter(self.ingesters.values()), None),
+            generator=self.generator,
+            broker=self.broker,
+        )
+        self._maybe_usage_reporter()
 
-        self.usage_reporter = None
+    # ------------------------------------------------------------------
+    def _build_role(self, role: str):
+        cfg = self.cfg
+        if role == "ingester":
+            iid = self._instance_id("ingester-0")
+            sub_cfg = DBConfig(**{**cfg.db.__dict__})
+            sub_cfg.wal_path = (cfg.db.wal_path or "wal") + f"/{iid}"
+            self.db = TempoDB(sub_cfg)
+            ing = Ingester(self.db, self.overrides, cfg.ingester, instance_id=iid)
+            self.ingesters[iid] = ing
+            self.ring = Ring(self._ring_kv(), replication_factor=cfg.replication_factor)
+            self.ring.register(iid, addr=cfg.advertise_addr)
+            self._registered.append((self.ring, iid))
+            self._heartbeat_stops.append(self.ring.start_heartbeat(iid))
+            self.rpc = RPCHandler(ingester=ing)
+            return
+
+        if role == "metrics-generator":
+            gid = self._instance_id("generator-0")
+            self.generator = Generator(self.overrides, instance_id=gid)
+            self.generator_ring = Ring(self._ring_kv("-generator"), replication_factor=1)
+            self.generator_ring.register(gid, addr=cfg.advertise_addr)
+            self._registered.append((self.generator_ring, gid))
+            self._heartbeat_stops.append(self.generator_ring.start_heartbeat(gid))
+            if cfg.remote_write is not None and cfg.remote_write.endpoint:
+                self.remote_write_storage = RemoteWriteStorage(cfg.remote_write)
+            self.rpc = RPCHandler(generator=self.generator)
+            return
+
+        if role == "distributor":
+            self.ring = Ring(self._ring_kv(), replication_factor=cfg.replication_factor)
+            gen_clients = {}
+            if cfg.generator_enabled:
+                self.generator_ring = Ring(self._ring_kv("-generator"), replication_factor=1)
+                gen_clients = RingClientPool(self.generator_ring, RemoteGenerator)
+            if cfg.forwarders:
+                from tempo_tpu.modules.forwarder import ForwarderManager
+
+                self.forwarder_manager = ForwarderManager(cfg.forwarders, self.overrides)
+            self.distributor = Distributor(
+                self.ring,
+                ingester_clients=RingClientPool(self.ring, RemoteIngester),
+                overrides=self.overrides,
+                generator_ring=self.generator_ring,
+                generator_clients=gen_clients,
+                forwarder_manager=self.forwarder_manager,
+            )
+            self.rpc = RPCHandler()
+            return
+
+        if role == "querier":
+            self.db = self._make_db()
+            self.ring = Ring(self._ring_kv(), replication_factor=cfg.replication_factor)
+            self.querier = Querier(
+                self.db, self.ring, ingester_clients=RingClientPool(self.ring, RemoteIngester)
+            )
+            if cfg.frontend_address:
+                self.remote_worker = RemoteWorker(
+                    cfg.frontend_address, self.querier, n_threads=cfg.query_workers
+                ).start()
+            self.rpc = RPCHandler()
+            return
+
+        if role == "query-frontend":
+            self.db = self._make_db()
+            self.broker = JobBroker()
+            self.frontend = Frontend(self.broker, self.db, cfg.frontend, self.overrides)
+            self.rpc = RPCHandler(broker=self.broker)
+            return
+
+        if role == "compactor":
+            self.db = self._make_db()
+            self.compactor = CompactorModule(self.db, ring=None)
+            self.rpc = RPCHandler()
+            return
+
+        raise AssertionError(role)
+
+    def _maybe_usage_reporter(self):
+        cfg = self.cfg
         if cfg.usage_stats is not None and getattr(cfg.usage_stats, "enabled", False):
             from tempo_tpu.usagestats import Reporter
 
             self.usage_reporter = Reporter(cfg.usage_stats, self.db.backend.raw)
-
-        # heartbeat every registered member — without this the whole ring
-        # goes unhealthy after heartbeat_timeout_s and ingest stops
-        self._heartbeat_stops = [self.ring.start_heartbeat(iid) for iid in self.ingesters]
-        if self.generator_ring is not None:
-            self._heartbeat_stops.append(self.generator_ring.start_heartbeat("generator-0"))
 
     # -- tenant resolution ----------------------------------------------
     def resolve_tenant(self, org_id: str | None) -> str:
@@ -129,33 +277,50 @@ class App:
         return org_id
 
     # -- API surface -----------------------------------------------------
+    def _require(self, member, what: str):
+        if member is None:
+            raise RoleUnavailable(f"this process (target={self.target}) does not serve {what}")
+        return member
+
     def push_traces(self, traces, org_id=None):
-        self.distributor.push_traces(self.resolve_tenant(org_id), traces)
+        self._require(self.distributor, "ingest").push_traces(
+            self.resolve_tenant(org_id), traces
+        )
 
     def find_trace(self, trace_id: bytes, org_id=None):
-        return self.frontend.find_trace_by_id(self.resolve_tenant(org_id), trace_id)
+        return self._require(self.frontend, "queries").find_trace_by_id(
+            self.resolve_tenant(org_id), trace_id
+        )
 
     def search(self, req: SearchRequest, org_id=None):
-        return self.frontend.search(self.resolve_tenant(org_id), req)
+        return self._require(self.frontend, "queries").search(self.resolve_tenant(org_id), req)
 
     def traceql(self, query: str, org_id=None, **kw):
-        return self.frontend.traceql(self.resolve_tenant(org_id), query, **kw)
+        return self._require(self.frontend, "queries").traceql(
+            self.resolve_tenant(org_id), query, **kw
+        )
 
     def search_tags(self, org_id=None) -> list[str]:
         """Reference: /api/search/tags is proxied by the frontend straight
         to queriers (no sharding middleware)."""
-        return self.querier.search_tags(self.resolve_tenant(org_id))
+        return self._require(self.querier, "tag queries").search_tags(
+            self.resolve_tenant(org_id)
+        )
 
     def search_tag_values(self, tag: str, org_id=None) -> list[str]:
-        return self.querier.search_tag_values(self.resolve_tenant(org_id), tag)
+        return self._require(self.querier, "tag queries").search_tag_values(
+            self.resolve_tenant(org_id), tag
+        )
 
     # -- lifecycle -------------------------------------------------------
     def start_loops(self):
         for ing in self.ingesters.values():
             ing.start_loop()
-        self.db.enable_polling()
-        self.compactor.start()
-        if self.remote_write_storage is not None:
+        if self.db is not None:
+            self.db.enable_polling()
+        if self.compactor is not None:
+            self.compactor.start()
+        if self.remote_write_storage is not None and self.generator is not None:
             self.remote_write_storage.start_loop(self.generator)
         if self.usage_reporter is not None:
             self.usage_reporter.start_loop()
@@ -165,17 +330,38 @@ class App:
         for ing in self.ingesters.values():
             ing.sweep(immediate=immediate)
 
+    def service_states(self) -> dict:
+        states = {"target": self.target}
+        for name in ("distributor", "querier", "frontend", "compactor", "generator"):
+            if getattr(self, name) is not None:
+                states[name] = "Running"
+        for iid in self.ingesters:
+            states[iid] = "Running"
+        return states
+
     def shutdown(self):
-        for stop in getattr(self, "_heartbeat_stops", []):
+        for stop in self._heartbeat_stops:
             stop.set()
+        for ring, iid in self._registered:
+            try:
+                ring.unregister(iid)
+            except Exception:
+                log.exception("ring unregister failed for %s", iid)
+        if self.remote_worker is not None:
+            self.remote_worker.stop()
         for ing in self.ingesters.values():
             ing.stop(flush=True)
-        self.workers.stop()
-        self.compactor.stop()
+        if self.workers is not None:
+            self.workers.stop()
+        elif self.broker is not None:
+            self.broker.stop()
+        if self.compactor is not None:
+            self.compactor.stop()
         if self.remote_write_storage is not None:
             self.remote_write_storage.stop()
         if self.forwarder_manager is not None:
             self.forwarder_manager.stop()
         if self.usage_reporter is not None:
             self.usage_reporter.stop()
-        self.db.shutdown()
+        if self.db is not None:
+            self.db.shutdown()

@@ -163,6 +163,11 @@ def parse_config(text: str, env: dict | None = None) -> Config:
     for key in ("replication_factor", "n_ingesters", "query_workers"):
         if key in doc:
             setattr(app, key, int(doc.pop(key)))
+    # microservices-mode identity + discovery (reference: memberlist join
+    # config + per-role flags)
+    for key in ("instance_id", "ring_kv_path", "advertise_addr", "frontend_address"):
+        if key in doc:
+            setattr(app, key, str(doc.pop(key)))
 
     if doc:
         raise ConfigError(f"{next(iter(doc))}: unknown top-level config key")

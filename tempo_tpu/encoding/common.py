@@ -65,6 +65,20 @@ class SearchRequest:
     limit: int = 20  # 0 = unbounded (matches the reference's semantics)
     query: str = ""  # raw TraceQL, handled by the traceql engine
 
+    def to_dict(self) -> dict:
+        """Wire form for the frontend<->querier job protocol (reference:
+        pkg/api request (de)serialization between shards and queriers)."""
+        import dataclasses
+
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "SearchRequest":
+        import dataclasses
+
+        known = {f.name for f in dataclasses.fields(SearchRequest)}
+        return SearchRequest(**{k: v for k, v in d.items() if k in known})
+
 
 @dataclass
 class TraceSearchMetadata:
@@ -105,3 +119,32 @@ class SearchResponse:
         self.inspected_bytes += other.inspected_bytes
         self.inspected_traces += other.inspected_traces
         self.inspected_blocks += other.inspected_blocks
+
+    def to_dict(self) -> dict:
+        return {
+            "traces": [t.to_dict() for t in self.traces],
+            "metrics": {
+                "inspectedTraces": self.inspected_traces,
+                "inspectedBytes": str(self.inspected_bytes),
+                "inspectedBlocks": self.inspected_blocks,
+            },
+        }
+
+    @staticmethod
+    def from_dict(doc: dict) -> "SearchResponse":
+        resp = SearchResponse()
+        for t in doc.get("traces", []):
+            resp.traces.append(
+                TraceSearchMetadata(
+                    trace_id_hex=t["traceID"],
+                    root_service_name=t.get("rootServiceName", ""),
+                    root_trace_name=t.get("rootTraceName", ""),
+                    start_time_unix_nano=int(t.get("startTimeUnixNano", "0")),
+                    duration_ms=t.get("durationMs", 0),
+                )
+            )
+        m = doc.get("metrics", {})
+        resp.inspected_traces = m.get("inspectedTraces", 0)
+        resp.inspected_bytes = int(m.get("inspectedBytes", "0"))
+        resp.inspected_blocks = m.get("inspectedBlocks", 0)
+        return resp

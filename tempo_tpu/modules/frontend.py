@@ -3,35 +3,27 @@
 Reference: modules/frontend (trace-by-ID sharder splitting the uuid
 space uniformly tracebyidsharding.go:51-228, search sharder emitting one
 job per chunk of block data searchsharding.go:69-314, retry retry.go,
-hedging, span deduping deduper.go) over the fair queue
-(modules/frontend/v1 + pkg/scheduler/queue).
+span deduping deduper.go) over the fair queue (modules/frontend/v1 +
+pkg/scheduler/queue).
 
-In-process form: sharders emit job callables into the RequestQueue;
-worker threads (the "queriers") execute them; the frontend waits on a
-completion latch and merges. The process boundary (httpgrpc in the
-reference) maps to the queue seam, so a networked deployment only swaps
-the queue transport.
+Jobs are wire-form descriptors (modules/worker.py): the frontend never
+executes anything itself. In-process, LocalWorkerPool drains the same
+broker that remote queriers long-poll over HTTP, so single-binary and
+microservice deployments share this exact code path — the process
+boundary is the broker seam (the reference's httpgrpc boundary).
 """
 
 from __future__ import annotations
 
 import logging
-import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
-from tempo_tpu.encoding.common import SearchRequest, SearchResponse
+from tempo_tpu.encoding.common import SearchRequest, SearchResponse, TraceSearchMetadata
 from tempo_tpu.model.trace import combine_traces
+from tempo_tpu.modules.worker import JobBroker, decode_trace_result
 
 log = logging.getLogger(__name__)
-
-
-def _client_error(e: Exception) -> bool:
-    """4xx-equivalents must not burn retries (reference retry.go:15
-    retries server errors only)."""
-    from tempo_tpu.traceql import ParseError
-
-    return isinstance(e, (ParseError, ValueError, PermissionError))
 
 
 def create_block_boundaries(n_shards: int) -> list[str]:
@@ -53,84 +45,85 @@ class FrontendConfig:
     target_bytes_per_job: int = 100 * 1024 * 1024
     query_ingesters_until_s: int = 3600  # recent window served by ingesters
     max_duration_s: int = 0  # per-tenant via overrides wins
-
-
-class _Latch:
-    def __init__(self, n: int):
-        self.n = n
-        self.results = []
-        self.errors = []
-        self.cv = threading.Condition()
-
-    def done(self, result=None, error=None):
-        with self.cv:
-            if error is not None:
-                self.errors.append(error)
-            elif result is not None:
-                self.results.append(result)
-            self.n -= 1
-            if self.n <= 0:
-                self.cv.notify_all()
-
-    def wait(self, timeout=60.0):
-        with self.cv:
-            if not self.cv.wait_for(lambda: self.n <= 0, timeout=timeout):
-                raise TimeoutError("query jobs timed out")
-        return self.results, self.errors
+    job_timeout_s: float = 60.0
 
 
 class Frontend:
-    def __init__(self, queue, querier, cfg: FrontendConfig | None = None, overrides=None):
-        self.queue = queue
-        self.querier = querier
+    def __init__(self, broker: JobBroker, db, cfg: FrontendConfig | None = None,
+                 overrides=None):
+        """db: blocklist provider (TempoDB reader); the frontend needs
+        block metas to shard searches (reference: frontend reads the
+        tempodb.Reader blocklist, searchsharding.go:250)."""
+        self.broker = broker
+        self.db = db
         self.cfg = cfg or FrontendConfig()
         self.overrides = overrides
 
     # ------------------------------------------------------------------
-    def _run_jobs(self, tenant: str, fns) -> tuple[list, list]:
-        latch = _Latch(len(fns))
+    # error-type prefixes that must not burn retries (reference retry.go
+    # retries 5xx only; worker errors travel as "Type: message" strings)
+    _CLIENT_ERRORS = ("ParseError", "ValueError", "PermissionError", "BadRequest")
 
-        def wrap(fn):
-            def job():
-                for attempt in range(self.cfg.max_retries + 1):
-                    try:
-                        latch.done(result=fn())
-                        return
-                    except Exception as e:  # retry ware (reference retry.go: 5xx only)
-                        if attempt >= self.cfg.max_retries or _client_error(e):
-                            latch.done(error=e)
-                            return
-                        log.warning("job retry %d after: %s", attempt + 1, e)
+    def _run_jobs(self, tenant: str, descs: list[dict]) -> tuple[list, list]:
+        """Submit all descriptors; resubmit failures up to max_retries.
+        A timed-out job that later completes AND gets retried can yield
+        a duplicate partial; all merge paths dedupe by trace/span
+        identity."""
+        from tempo_tpu.modules.worker import JobError
 
-            return job
-
-        for fn in fns:
-            self.queue.enqueue(tenant, wrap(fn))
-        return latch.wait()
+        pendings = [self.broker.submit(tenant, d) for d in descs]
+        results: list = []
+        terminal_errors: list = []  # client errors: never retried, never lost
+        for attempt in range(self.cfg.max_retries + 1):
+            self.broker.wait_all(pendings, timeout_s=self.cfg.job_timeout_s)
+            # classify each pending exactly once — a job finishing between
+            # two passes must land in exactly one bucket
+            failed = []
+            for p in pendings:
+                if p.event.is_set() and p.error is None:
+                    results.append(p.result)
+                elif p.error is not None and p.error.startswith(self._CLIENT_ERRORS):
+                    terminal_errors.append(JobError(p.error))  # not retryable
+                else:
+                    failed.append(p)
+            if not failed or attempt == self.cfg.max_retries:
+                for p in failed:
+                    terminal_errors.append(
+                        JobError(p.error) if p.error is not None
+                        else TimeoutError(f"job {p.job_id} timed out")
+                    )
+                return results, terminal_errors
+            log.warning(
+                "retrying %d failed query jobs (attempt %d/%d)",
+                len(failed), attempt + 1, self.cfg.max_retries,
+            )
+            pendings = [self.broker.submit(tenant, p.desc) for p in failed]
+        return results, terminal_errors
 
     # ------------------------------------------------------------------
     def find_trace_by_id(self, tenant: str, trace_id: bytes):
         """Shard the blockID space + one ingester job; combine partials,
         dedupe spans (reference: newTraceByIDMiddleware frontend.go:97)."""
+        hex_id = trace_id.hex()
         bounds = create_block_boundaries(self.cfg.query_shards)
-        jobs = [
-            lambda: self.querier.find_trace_by_id(tenant, trace_id, mode="ingesters")
-        ]
+        descs = [{"kind": "find", "trace_id": hex_id, "mode": "ingesters"}]
         for i in range(len(bounds) - 1):
-            lo, hi = bounds[i], bounds[i + 1]
-            jobs.append(
-                lambda lo=lo, hi=hi: self.querier.find_trace_by_id(
-                    tenant, trace_id, mode="blocks", block_start=lo, block_end=hi
-                )
+            descs.append(
+                {
+                    "kind": "find",
+                    "trace_id": hex_id,
+                    "mode": "blocks",
+                    "block_start": bounds[i],
+                    "block_end": bounds[i + 1],
+                }
             )
-        results, errors = self._run_jobs(tenant, jobs)
+        results, errors = self._run_jobs(tenant, descs)
         if errors:
             # a failed shard could hide spans of this trace; fail the whole
-            # query rather than return a silently incomplete trace (the
-            # reference fails the request when any sub-request exhausts
-            # retries, frontend retry.go + deduper)
+            # query rather than return a silently incomplete trace
             raise errors[0]
-        return combine_traces([r for r in results if r is not None])
+        traces = [decode_trace_result(r) for r in results]
+        return combine_traces([t for t in traces if t is not None])
 
     # ------------------------------------------------------------------
     def search(self, tenant: str, req: SearchRequest) -> SearchResponse:
@@ -143,48 +136,59 @@ class Frontend:
                     raise ValueError(f"search window exceeds max_search_duration ({max_dur}s)")
 
         now = time.time()
-        jobs = []
+        descs = []
         ing_cutoff = now - self.cfg.query_ingesters_until_s
         if not req.end_seconds or req.end_seconds >= ing_cutoff:
-            jobs.append(lambda: self.querier.search_recent(tenant, req))
+            descs.append({"kind": "search_recent", "search": req.to_dict()})
 
         metas = [
-            m for m in self.querier.db.blocklist.metas(tenant)
+            m for m in self.db.blocklist.metas(tenant)
             if (not req.start_seconds or m.end_time >= req.start_seconds)
             and (not req.end_seconds or m.start_time <= req.end_seconds)
         ]
         group, size = [], 0
         for m in metas:
-            group.append(m)
+            group.append(m.block_id)
             size += max(m.size_bytes, 1)
             if size >= self.cfg.target_bytes_per_job:
-                jobs.append(self._block_group_job(tenant, group, req))
+                descs.append({"kind": "search_blocks", "block_ids": group, "search": req.to_dict()})
                 group, size = [], 0
         if group:
-            jobs.append(self._block_group_job(tenant, group, req))
+            descs.append({"kind": "search_blocks", "block_ids": group, "search": req.to_dict()})
 
-        results, errors = self._run_jobs(tenant, jobs)
+        results, errors = self._run_jobs(tenant, descs)
         if errors:
             raise errors[0]
         out = SearchResponse()
         for r in results:
-            out.merge(r, limit=req.limit)
+            if "response" in r:
+                out.merge(SearchResponse.from_dict(r["response"]), limit=req.limit)
         return out
-
-    def _block_group_job(self, tenant, group, req):
-        def job():
-            resp = SearchResponse()
-            for m in group:
-                resp.merge(self.querier.search_block_job(tenant, m.block_id, req), limit=req.limit)
-            return resp
-
-        return job
 
     # ------------------------------------------------------------------
     def traceql(self, tenant: str, query: str, start_s=0, end_s=0, limit=20):
+        # parse up front: a malformed query is a client error and must
+        # fail before any job is sharded or retried (reference: the
+        # frontend's search middleware parses before enqueueing)
+        from tempo_tpu.traceql import parse
+
+        parse(query)
         results, errors = self._run_jobs(
-            tenant, [lambda: self.querier.traceql(tenant, query, start_s, end_s, limit)]
+            tenant,
+            [{"kind": "traceql", "q": query, "start": start_s, "end": end_s, "limit": limit}],
         )
         if errors and not results:
             raise errors[0]
-        return results[0] if results else []
+        out = []
+        for r in results:
+            for t in r.get("results", []):
+                out.append(
+                    TraceSearchMetadata(
+                        trace_id_hex=t["traceID"],
+                        root_service_name=t.get("rootServiceName", ""),
+                        root_trace_name=t.get("rootTraceName", ""),
+                        start_time_unix_nano=int(t.get("startTimeUnixNano", "0")),
+                        duration_ms=t.get("durationMs", 0),
+                    )
+                )
+        return out

@@ -124,23 +124,7 @@ class Querier:
         )
         import json
 
-        doc = json.loads(body)
-        resp = SearchResponse()
-        for t in doc.get("traces", []):
-            resp.traces.append(
-                TraceSearchMetadata(
-                    trace_id_hex=t["traceID"],
-                    root_service_name=t.get("rootServiceName", ""),
-                    root_trace_name=t.get("rootTraceName", ""),
-                    start_time_unix_nano=int(t.get("startTimeUnixNano", "0")),
-                    duration_ms=t.get("durationMs", 0),
-                )
-            )
-        m = doc.get("metrics", {})
-        resp.inspected_traces = m.get("inspectedTraces", 0)
-        resp.inspected_bytes = int(m.get("inspectedBytes", "0"))
-        resp.inspected_blocks = m.get("inspectedBlocks", 0)
-        return resp
+        return SearchResponse.from_dict(json.loads(body))
 
     def search_tags(self, tenant: str) -> list[str]:
         """Tag names in not-yet-flushed ingester data (reference:

@@ -73,37 +73,3 @@ class RequestQueue:
         with self._cv:
             self._stopped = True
             self._cv.notify_all()
-
-
-class WorkerPool:
-    """Pull workers executing queue jobs (the querier worker half,
-    reference: modules/querier/worker)."""
-
-    def __init__(self, queue: RequestQueue, n_workers: int = 4):
-        self.queue = queue
-        self.threads = [
-            threading.Thread(target=self._run, daemon=True, name=f"query-worker-{i}")
-            for i in range(n_workers)
-        ]
-        for t in self.threads:
-            t.start()
-
-    def _run(self):
-        while True:
-            item = self.queue.dequeue(timeout=0.5)
-            if item is None:
-                if self.queue._stopped:
-                    return
-                continue
-            _, job = item
-            try:
-                job()
-            except Exception:
-                import logging
-
-                logging.getLogger(__name__).exception("query job failed")
-
-    def stop(self):
-        self.queue.stop()
-        for t in self.threads:
-            t.join(timeout=2)

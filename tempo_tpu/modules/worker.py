@@ -1,0 +1,257 @@
+"""Frontend<->querier job protocol: descriptors, broker, pull workers.
+
+Reference: modules/frontend/v1 (queriers connect and PULL jobs over a
+gRPC Process stream, frontend.go:196; dead workers' jobs are re-enqueued)
++ modules/querier/worker (frontend_processor.go runs the inlined request
+and posts the result back). Here jobs are JSON descriptors (the pkg/api
+contract: every sub-request the sharders emit is expressible as plain
+params), the transport is HTTP long-poll + result POST, and in-process
+deployments use the same broker with local workers, so single-binary and
+microservice modes run identical code paths.
+
+Descriptor kinds:
+  find          {trace_id, mode, block_start, block_end}
+  search_recent {search}
+  search_blocks {block_ids, search}
+  traceql       {q, start, end, limit}
+Results are JSON-safe dicts; traces travel as b64 OTLP protobuf.
+"""
+
+from __future__ import annotations
+
+import base64
+import itertools
+import logging
+import threading
+import time
+
+from tempo_tpu.encoding.common import SearchRequest, SearchResponse
+from tempo_tpu.modules.queue import RequestQueue
+
+log = logging.getLogger(__name__)
+
+
+# -- executing a descriptor on a querier ---------------------------------
+def execute_job(querier, tenant: str, desc: dict) -> dict:
+    kind = desc.get("kind")
+    if kind == "find":
+        trace = querier.find_trace_by_id(
+            tenant,
+            bytes.fromhex(desc["trace_id"]),
+            mode=desc.get("mode", "all"),
+            block_start=desc.get("block_start", "0" * 32),
+            block_end=desc.get("block_end", "f" * 32),
+        )
+        if trace is None:
+            return {"trace_b64": None}
+        from tempo_tpu.receivers import otlp
+
+        return {"trace_b64": base64.b64encode(otlp.encode_traces_request([trace])).decode()}
+    if kind == "search_recent":
+        req = SearchRequest.from_dict(desc["search"])
+        return {"response": querier.search_recent(tenant, req).to_dict()}
+    if kind == "search_blocks":
+        req = SearchRequest.from_dict(desc["search"])
+        resp = SearchResponse()
+        for block_id in desc["block_ids"]:
+            resp.merge(querier.search_block_job(tenant, block_id, req), limit=req.limit)
+        return {"response": resp.to_dict()}
+    if kind == "traceql":
+        hits = querier.traceql(
+            tenant, desc["q"], desc.get("start", 0), desc.get("end", 0), desc.get("limit", 20)
+        )
+        return {"results": [h.to_dict() for h in hits]}
+    raise ValueError(f"unknown job kind {kind!r}")
+
+
+def decode_trace_result(result: dict):
+    b64 = result.get("trace_b64")
+    if not b64:
+        return None
+    from tempo_tpu.receivers import otlp
+
+    traces = otlp.decode_traces_request(base64.b64decode(b64))
+    return traces[0] if traces else None
+
+
+class JobError(Exception):
+    pass
+
+
+class _Pending:
+    __slots__ = ("job_id", "tenant", "desc", "event", "result", "error", "deadline")
+
+    def __init__(self, job_id, tenant, desc):
+        self.job_id = job_id
+        self.tenant = tenant
+        self.desc = desc
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+        self.deadline = 0.0
+
+
+class JobBroker:
+    """Frontend-side: fair queue of descriptors + in-flight tracking with
+    lease timeout re-enqueue (the reference re-enqueues when a querier's
+    Process stream dies, frontend v1)."""
+
+    def __init__(self, queue: RequestQueue | None = None, lease_s: float = 30.0):
+        self.queue = queue or RequestQueue()
+        self.lease_s = lease_s
+        self._ids = itertools.count(1)
+        self._inflight: dict[str, _Pending] = {}
+        self._lock = threading.Lock()
+
+    def submit(self, tenant: str, desc: dict) -> _Pending:
+        p = _Pending(f"job-{next(self._ids)}", tenant, desc)
+        self.queue.enqueue(tenant, p)
+        return p
+
+    def pull(self, timeout: float = 10.0):
+        """Next due job -> (job_id, tenant, desc) or None. Also reaps
+        expired leases back into the queue."""
+        self._reap()
+        item = self.queue.dequeue(timeout=timeout)
+        if item is None:
+            return None
+        _, p = item
+        with self._lock:
+            p.deadline = time.monotonic() + self.lease_s
+            self._inflight[p.job_id] = p
+        return p.job_id, p.tenant, p.desc
+
+    def complete(self, job_id: str, result: dict | None = None, error: str | None = None) -> bool:
+        with self._lock:
+            p = self._inflight.pop(job_id, None)
+        if p is None:
+            return False  # lease expired and job was re-run elsewhere
+        p.result = result
+        p.error = error
+        p.event.set()
+        return True
+
+    def _reap(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            expired = [p for p in self._inflight.values() if p.deadline and p.deadline < now]
+            for p in expired:
+                del self._inflight[p.job_id]
+        for p in expired:
+            log.warning("job %s lease expired; re-enqueueing", p.job_id)
+            try:
+                self.queue.enqueue(p.tenant, p)
+            except Exception as e:  # queue full/stopped: fail the waiter,
+                # never the puller's thread (a dropped pending would
+                # otherwise block its frontend for the full job timeout)
+                p.error = f"requeue after lease expiry failed: {e}"
+                p.event.set()
+
+    def wait_all(self, pendings: list, timeout_s: float = 60.0):
+        """Wait for every pending job; returns (results, errors)."""
+        deadline = time.monotonic() + timeout_s
+        results, errors = [], []
+        for p in pendings:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not p.event.wait(timeout=remaining):
+                errors.append(TimeoutError(f"job {p.job_id} timed out"))
+                continue
+            if p.error is not None:
+                errors.append(JobError(p.error))
+            else:
+                results.append(p.result)
+        return results, errors
+
+    def stop(self) -> None:
+        self.queue.stop()
+
+
+class LocalWorkerPool:
+    """In-process pull workers (single-binary mode)."""
+
+    def __init__(self, broker: JobBroker, querier, n_workers: int = 4,
+                 max_retries: int = 2):
+        self.broker = broker
+        self.querier = querier
+        self.max_retries = max_retries
+        self._stop = threading.Event()
+        self.threads = [
+            threading.Thread(target=self._run, daemon=True, name=f"query-worker-{i}")
+            for i in range(n_workers)
+        ]
+        for t in self.threads:
+            t.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            item = self.broker.pull(timeout=0.5)
+            if item is None:
+                if self.broker.queue._stopped:
+                    return
+                continue
+            job_id, tenant, desc = item
+            try:
+                self.broker.complete(job_id, result=execute_job(self.querier, tenant, desc))
+            except Exception as e:  # noqa: BLE001 — error travels to the waiter
+                self.broker.complete(job_id, error=f"{type(e).__name__}: {e}")
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.broker.stop()
+        for t in self.threads:
+            t.join(timeout=2)
+
+
+class RemoteWorker:
+    """Querier-side: long-polls a frontend over HTTP, executes jobs on
+    the local querier, posts results (reference: modules/querier/worker
+    DNS-discovers frontends and opens Process streams)."""
+
+    def __init__(self, frontend_url: str, querier, n_threads: int = 2):
+        from tempo_tpu.backend.httpclient import PooledHTTPClient
+
+        self.client = PooledHTTPClient(frontend_url, timeout_s=30.0, max_retries=0)
+        self.querier = querier
+        self._stop = threading.Event()
+        self.threads = [
+            threading.Thread(target=self._run, daemon=True, name=f"remote-worker-{i}")
+            for i in range(n_threads)
+        ]
+
+    def start(self) -> "RemoteWorker":
+        for t in self.threads:
+            t.start()
+        return self
+
+    def _run(self) -> None:
+        import json
+
+        while not self._stop.is_set():
+            try:
+                status, body, _ = self.client.request(
+                    "POST", "/rpc/v1/worker/pull", body=b"{}", ok=(200, 204)
+                )
+                if status == 204 or not body:
+                    continue
+                job = json.loads(body)
+                job_id, tenant, desc = job["job_id"], job["tenant"], job["desc"]
+                try:
+                    out = {"result": execute_job(self.querier, tenant, desc)}
+                except Exception as e:  # noqa: BLE001
+                    out = {"error": f"{type(e).__name__}: {e}"}
+                self.client.request(
+                    "POST",
+                    f"/rpc/v1/worker/result/{job_id}",
+                    headers={"Content-Type": "application/json"},
+                    body=json.dumps(out).encode(),
+                    ok=(200, 404),  # 404: lease expired, someone else ran it
+                )
+            except Exception as e:  # frontend down: back off and retry
+                if not self._stop.is_set():
+                    log.debug("worker poll failed: %s", e)
+                    self._stop.wait(0.5)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self.threads:
+            t.join(timeout=2)

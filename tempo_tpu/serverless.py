@@ -69,14 +69,7 @@ class SearchBlockHandler:
 def response_to_dict(resp: SearchResponse) -> dict:
     """The same JSON shape the /api/search endpoint returns — frontends
     merge serverless partials interchangeably with querier partials."""
-    return {
-        "traces": [t.to_dict() for t in resp.traces],
-        "metrics": {
-            "inspectedTraces": resp.inspected_traces,
-            "inspectedBytes": str(resp.inspected_bytes),
-            "inspectedBlocks": resp.inspected_blocks,
-        },
-    }
+    return resp.to_dict()
 
 
 class ServerlessServer:

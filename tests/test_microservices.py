@@ -1,0 +1,176 @@
+"""Microservices-mode e2e: separate role apps talking over real HTTP.
+
+Reference pattern: integration/e2e TestMicroservicesWithKVStores — 3
+ingesters, distributor, querier, query-frontend as separate processes;
+an ingester is killed mid-test and reads must survive via RF (e2e_test.go:130).
+Here each role is a real App+TempoServer on its own port in one test
+process (identical code paths; the process boundary is the HTTP seam
+exercised for push, find, live-batch transfer, and the worker pull
+protocol)."""
+
+import time
+
+import pytest
+
+from tempo_tpu.app import App, AppConfig, RoleUnavailable
+from tempo_tpu.api.server import TempoServer
+from tempo_tpu.backend.httpclient import HTTPError, PooledHTTPClient
+from tempo_tpu.db import DBConfig
+from tempo_tpu.model import synth
+from tempo_tpu.receivers import otlp
+
+
+def _role_cfg(tmp_path, target, instance_id="", frontend_address=""):
+    return AppConfig(
+        target=target,
+        db=DBConfig(
+            backend="local",
+            backend_path=str(tmp_path / "blocks"),
+            wal_path=str(tmp_path / "wal"),
+            blocklist_poll_s=3600.0,
+        ),
+        replication_factor=2,
+        generator_enabled=False,
+        instance_id=instance_id,
+        ring_kv_path=str(tmp_path / "ring.json"),
+        frontend_address=frontend_address,
+        query_workers=2,
+    )
+
+
+class _Cluster:
+    def __init__(self, tmp_path):
+        self.tmp = tmp_path
+        self.nodes = {}  # name -> (app, server)
+
+    def start(self, target, name, **kw):
+        cfg = _role_cfg(self.tmp, target, instance_id=name, **kw)
+        app = App(cfg)
+        srv = TempoServer(app).start()
+        if target == "ingester":
+            # advertise the real port: re-register with addr now known
+            app.ring.register(name, addr=srv.url)
+        app.start_loops()
+        self.nodes[name] = (app, srv)
+        return app, srv
+
+    def kill(self, name):
+        app, srv = self.nodes.pop(name)
+        srv.stop()
+        app.shutdown()
+
+    def stop_all(self):
+        for name in list(self.nodes):
+            self.kill(name)
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = _Cluster(tmp_path)
+    yield c
+    c.stop_all()
+
+
+def test_microservices_cluster(cluster):
+    # 3 ingesters, RF=2
+    for i in range(3):
+        cluster.start("ingester", f"ingester-{i}")
+    dist_app, dist_srv = cluster.start("distributor", "distributor-0")
+    fe_app, fe_srv = cluster.start("query-frontend", "frontend-0")
+    q_app, _ = cluster.start("querier", "querier-0", frontend_address=fe_srv.url)
+
+    # ingest through the distributor's OTLP endpoint over HTTP
+    traces = synth.make_traces(12, seed=31)
+    c = PooledHTTPClient(dist_srv.url)
+    status, _, _ = c.request(
+        "POST",
+        "/v1/traces",
+        headers={"Content-Type": "application/x-protobuf"},
+        body=otlp.encode_traces_request(traces),
+        ok=(200,),
+    )
+    assert status == 200
+
+    # query by ID through the frontend over HTTP: served from ingester
+    # live data via the worker pull protocol + ingester RPC fan-out
+    fc = PooledHTTPClient(fe_srv.url)
+    _, body, _ = fc.request(
+        "GET",
+        f"/api/traces/{traces[0].trace_id.hex()}",
+        headers={"Accept": "application/protobuf"},
+        ok=(200,),
+    )
+    got = otlp.decode_traces_request(body)[0]
+    assert got.span_count() == traces[0].span_count()
+
+    # search over live data
+    svc = traces[1].batches[0][0]["service.name"]
+    import json
+
+    _, body, _ = fc.request("GET", f"/api/search?tags=service%3D{svc}&limit=100")
+    assert traces[1].trace_id.hex() in {t["traceID"] for t in json.loads(body)["traces"]}
+
+    # RF tolerance: kill one ingester; every trace must still be readable
+    cluster.kill("ingester-1")
+    for t in traces:
+        _, body, _ = fc.request(
+            "GET",
+            f"/api/traces/{t.trace_id.hex()}",
+            headers={"Accept": "application/protobuf"},
+            ok=(200,),
+        )
+        got = otlp.decode_traces_request(body)[0]
+        assert got.span_count() == t.span_count(), "spans lost after ingester death"
+
+    # flush the remaining ingesters to the backend, poll, query from blocks
+    for name, (app, _) in list(cluster.nodes.items()):
+        if name.startswith("ingester-"):
+            app.sweep_all(immediate=True)
+    fe_app.db.poll_now()
+    q_app.db.poll_now()
+    assert fe_app.db.blocklist.metas("single-tenant")
+    _, body, _ = fc.request(
+        "GET",
+        f"/api/traces/{traces[5].trace_id.hex()}",
+        headers={"Accept": "application/protobuf"},
+        ok=(200,),
+    )
+    assert otlp.decode_traces_request(body)[0].span_count() == traces[5].span_count()
+
+
+def test_role_guards(tmp_path):
+    """A role process rejects APIs it does not serve."""
+    app = App(_role_cfg(tmp_path, "ingester", instance_id="ingester-x"))
+    try:
+        with pytest.raises(RoleUnavailable):
+            app.find_trace(b"\x00" * 16)
+        with pytest.raises(RoleUnavailable):
+            app.push_traces([])
+    finally:
+        app.shutdown()
+
+
+def test_role_requires_ring_kv(tmp_path):
+    cfg = _role_cfg(tmp_path, "distributor")
+    cfg.ring_kv_path = ""
+    with pytest.raises(ValueError, match="ring_kv_path"):
+        App(cfg)
+
+
+def test_distributor_writes_survive_one_ingester_down(cluster):
+    """Post-kill writes keep working: the dead instance leaves the ring
+    on shutdown and the quorum logic rides the healthy set."""
+    for i in range(3):
+        cluster.start("ingester", f"ingester-{i}")
+    dist_app, dist_srv = cluster.start("distributor", "distributor-0")
+    cluster.kill("ingester-2")
+    traces = synth.make_traces(4, seed=33)
+    c = PooledHTTPClient(dist_srv.url)
+    status, _, _ = c.request(
+        "POST",
+        "/v1/traces",
+        headers={"Content-Type": "application/x-protobuf"},
+        body=otlp.encode_traces_request(traces),
+        ok=(200,),
+    )
+    assert status == 200
