@@ -28,10 +28,12 @@ def _backend(args):
 
 
 def _open_block(backend, tenant: str, block_id: str):
-    from tempo_tpu.encoding.vtpu.block import VtpuBackendBlock
+    """Open with the encoding named in the block meta (reference:
+    FromVersion dispatch at open, tempodb/encoding/versioned.go:54)."""
+    from tempo_tpu import encoding as encoding_registry
 
     meta = backend.block_meta(tenant, block_id)
-    return VtpuBackendBlock(meta, backend)
+    return encoding_registry.from_version(meta.version).open_block(meta, backend)
 
 
 def _fmt_ts(sec: int) -> str:
@@ -178,11 +180,11 @@ def cmd_query_trace(args) -> int:
     be = _backend(args)
     tid = parse_trace_id(args.trace_id)
     metas, _ = _tenant_metas(be, args.tenant)
-    from tempo_tpu.encoding.vtpu.block import VtpuBackendBlock
+    from tempo_tpu import encoding as encoding_registry
 
     hits = []
     for m in metas:
-        blk = VtpuBackendBlock(m, be)
+        blk = encoding_registry.from_version(m.version).open_block(m, be)
         t = blk.find_trace_by_id(tid)
         if t is not None:
             hits.append(t)
@@ -199,7 +201,8 @@ def cmd_query_trace(args) -> int:
 def cmd_query_search(args) -> int:
     from tempo_tpu.api.params import parse_logfmt_tags
     from tempo_tpu.encoding.common import SearchRequest
-    from tempo_tpu.encoding.vtpu.block import VtpuBackendBlock
+
+    from tempo_tpu import encoding as encoding_registry
 
     be = _backend(args)
     req = SearchRequest(tags=parse_logfmt_tags(args.tags or ""), limit=args.limit, query=args.q or "")
@@ -209,7 +212,7 @@ def cmd_query_search(args) -> int:
         from tempo_tpu.traceql import execute
 
         for m in metas:
-            blk = VtpuBackendBlock(m, be)
+            blk = encoding_registry.from_version(m.version).open_block(m, be)
 
             def fetcher(spec, s, e, _blk=blk):
                 return _blk.fetch_candidates(spec, s, e)
@@ -217,7 +220,7 @@ def cmd_query_search(args) -> int:
             results.extend(execute(req.query, fetcher, limit=req.limit))
     else:
         for m in metas:
-            blk = VtpuBackendBlock(m, be)
+            blk = encoding_registry.from_version(m.version).open_block(m, be)
             results.extend(blk.search(req).traces)
     seen = set()
     for r in sorted(results, key=lambda r: -r.start_time_unix_nano):
@@ -268,6 +271,41 @@ def cmd_gen_index(args) -> int:
     metas, compacted = _tenant_metas(be, args.tenant)
     write_tenant_index(be.raw, args.tenant, TenantIndex(created_at=time.time(), metas=metas, compacted=compacted))
     print(f"wrote tenant index: {len(metas)} blocks, {len(compacted)} compacted")
+    return 0
+
+
+def cmd_convert(args) -> int:
+    """Re-encode one block into another registered encoding (reference:
+    cmd-convert-parquet-*.go — offline format migration). Writes a NEW
+    block; the source is left untouched unless --mark-compacted."""
+    import time
+
+    from tempo_tpu import encoding as encoding_registry
+    from tempo_tpu.encoding.common import BlockConfig
+    from tempo_tpu.model.columnar import SpanBatch
+
+    be = _backend(args)
+    blk = _open_block(be, args.tenant, args.block)
+    src_version = blk.meta.version
+    enc = encoding_registry.from_version(args.to)
+
+    # collect + re-sort: encodings require trace-sorted batches sharing
+    # one dictionary, and row-group/page boundaries differ per encoding
+    batches = list(blk.iter_trace_batches())
+    if not batches:
+        print("source block is empty; nothing to convert")
+        return 1
+    merged = SpanBatch.concat(batches).sorted_by_trace()
+    cfg = BlockConfig(version=args.to)
+    meta = enc.create_block([merged], args.tenant, be, cfg,
+                            compaction_level=blk.meta.compaction_level)
+    print(
+        f"converted {args.block} ({src_version}) -> {meta.block_id} ({meta.version}): "
+        f"{meta.total_objects} traces, {meta.total_spans} spans"
+    )
+    if args.mark_compacted:
+        be.mark_block_compacted(args.tenant, args.block, time.time())
+        print(f"marked source {args.block} compacted")
     return 0
 
 
@@ -327,6 +365,14 @@ def build_parser() -> argparse.ArgumentParser:
     gi = gen.add_parser("index")
     gi.add_argument("tenant")
     gi.set_defaults(fn=cmd_gen_index)
+
+    cv = sub.add_parser("convert", help="re-encode a block into another encoding")
+    cv.add_argument("tenant")
+    cv.add_argument("block")
+    cv.add_argument("--to", required=True, help="target encoding version (vtpu1|vrow1)")
+    cv.add_argument("--mark-compacted", action="store_true",
+                    help="mark the source block compacted after converting")
+    cv.set_defaults(fn=cmd_convert)
 
     return p
 

@@ -260,6 +260,11 @@ class VrowBackendBlock:
                     out.extend(batch_to_traces(rfmt.decode_record_payload(payload)))
         return out
 
+    def iter_trace_batches(self):
+        """All spans, one SpanBatch per page record stream — the
+        block-convert read surface (mirrors VtpuBackendBlock's)."""
+        yield from self._iter_page_batches()
+
     def iter_records_raw(self):
         """(hex_id, record_payload) stream in ID order, for compaction."""
         idx = self.index()

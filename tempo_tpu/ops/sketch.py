@@ -64,11 +64,10 @@ def hll_update(regs: jnp.ndarray, limbs: jnp.ndarray, p: HLLPlan,
     # rank = position of first set bit in an independent 32-bit stream, 1-based
     rho = jax.lax.clz(h_rho).astype(jnp.uint32) + jnp.uint32(1)
     if valid is not None:
-        idx = jnp.where(valid, idx, jnp.uint32(p.m))  # trash slot
-        regs = jnp.concatenate([regs, jnp.zeros((1,), jnp.uint32)])
-        regs = regs.at[idx].max(rho)
-        return regs[: p.m]
-    return regs.at[idx].max(rho)
+        # OOB index + drop mode discards padded lanes (no trash-slot
+        # concat/slice, which forced an extra copy of the registers)
+        idx = jnp.where(valid, idx, jnp.uint32(p.m))
+    return regs.at[idx].max(rho, mode="drop")
 
 
 @jax.jit
@@ -127,7 +126,9 @@ def cm_update(counts: jnp.ndarray, limbs: jnp.ndarray, p: CMPlan,
         w = jnp.where(valid, w, jnp.uint32(0))
     rows = jnp.broadcast_to(jnp.arange(p.depth, dtype=jnp.uint32)[:, None], idx.shape)
     flat = rows.ravel() * jnp.uint32(p.width) + idx.ravel()
-    out = counts.ravel().at[flat].add(jnp.broadcast_to(w[None, :], idx.shape).ravel())
+    out = counts.ravel().at[flat].add(
+        jnp.broadcast_to(w[None, :], idx.shape).ravel(), mode="drop"
+    )
     return out.reshape(p.depth, p.width)
 
 
