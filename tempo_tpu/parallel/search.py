@@ -1,0 +1,145 @@
+"""Mesh-sharded search: block/page ranges fanned across devices.
+
+Reference strategies P3/P4 (SURVEY.md §2.8): the frontend shards
+trace-by-ID over the uniform blockID space pruning on bloom tests, and
+search over chunks of block pages. Here both fan-outs also exist
+*device-side*: row-group batches from many blocks stack on the mesh's
+range axis, every device scans its shard with the same fused predicate
+kernels the single-chip path uses, and partial results merge with
+collectives over ICI — `psum` for hit counts, `all_gather`-free masks
+that stay sharded (hit rows are gathered host-side only for the shards
+that matched, which is the reference's early-exit economy: most shards
+return nothing).
+
+Static shapes: shards are padded to one bucket size so the jitted
+program is shared across calls (reference analog: targetBytesPerRequest
+makes jobs uniform).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from tempo_tpu.ops import bloom
+from tempo_tpu.parallel.mesh import RANGE_AXIS, WINDOW_AXIS
+
+
+def make_sharded_tag_scan(mesh, n_cols: int, max_codes: int = 64):
+    """Jitted sharded equality-set scan.
+
+    Inputs (stacked over (W, R) mesh axes):
+      cols  (W, R, C, N) uint32 — C predicate columns per shard row
+      codes (C, K) uint32       — per-column accepted code sets, padded
+                                  with NO_MATCH sentinel (replicated)
+      valid (W, R, N) bool
+    Returns:
+      mask (W, R, N) bool  — sharded per-span hit mask (AND over columns)
+      hits (W, 1) int32    — global hit count per window (psum over range)
+    """
+
+    def local(cols, codes, valid):
+        # cols (C, N), codes (C, K), valid (N,)
+        hit = valid
+        for c in range(n_cols):
+            col = cols[c]
+            ok = jnp.zeros(col.shape, bool)
+            for k in range(max_codes):
+                code = codes[c, k]
+                # padding sentinel in the code set never matches, even
+                # against a column that happens to contain the sentinel
+                ok = ok | ((col == code) & (code != jnp.uint32(0xFFFFFFFF)))
+            hit = hit & ok
+        count = jnp.sum(hit.astype(jnp.int32))
+        total = jax.lax.psum(count, RANGE_AXIS)
+        return hit, total
+
+    def step(cols, codes, valid):
+        hit, total = local(cols[0, 0], codes, valid[0, 0])
+        return hit[None, None], total[None, None]
+
+    return jax.jit(
+        shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(P(WINDOW_AXIS, RANGE_AXIS), P(), P(WINDOW_AXIS, RANGE_AXIS)),
+            out_specs=(P(WINDOW_AXIS, RANGE_AXIS), P(WINDOW_AXIS)),
+            check_vma=False,
+        )
+    )
+
+
+def make_sharded_bloom_test(mesh, p: bloom.BloomPlan):
+    """Vmapped bloom membership test over mesh-sharded block ranges
+    (P3: 'bloom tests vmapped' — one query ID against many blocks'
+    filters at once).
+
+    Inputs:
+      words (W, R, S, words_per_shard) uint32 — one bloom (all shards)
+                                                per device slot
+      limbs (M, 4) uint32 — query IDs (replicated)
+    Returns:
+      maybe (W, R, M) bool — per-block-range verdicts (no collective:
+      the caller wants to know WHICH ranges to open)
+    """
+
+    def local(words, limbs):
+        # words (S, wps); test every query against this block's filter
+        return bloom.test(words, limbs, p)
+
+    def step(words, limbs):
+        return local(words[0, 0], limbs)[None, None]
+
+    return jax.jit(
+        shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(P(WINDOW_AXIS, RANGE_AXIS), P()),
+            out_specs=P(WINDOW_AXIS, RANGE_AXIS),
+            check_vma=False,
+        )
+    )
+
+
+NO_MATCH = np.uint32(0xFFFFFFFF)
+
+
+def pack_predicates(code_sets: list[np.ndarray], max_codes: int) -> np.ndarray:
+    """(C, K) uint32 code matrix padded with the NO_MATCH sentinel."""
+    out = np.full((len(code_sets), max_codes), NO_MATCH, np.uint32)
+    for i, cs in enumerate(code_sets):
+        if len(cs) > max_codes:
+            raise ValueError(f"predicate {i}: {len(cs)} codes > max_codes {max_codes}")
+        out[i, : len(cs)] = cs
+    return out
+
+
+def stack_shards(arrays: list[np.ndarray], w: int, r: int, pad_to: int,
+                 fill=0) -> tuple[np.ndarray, np.ndarray]:
+    """Stack per-shard row batches into the (W, R, ..., pad_to) device
+    layout; returns (stacked, valid)."""
+    total = w * r
+    if len(arrays) > total:
+        raise ValueError(f"{len(arrays)} shards > mesh capacity {total}")
+    sample = arrays[0]
+    inner = sample.shape[:-1]
+    stacked = np.full((total, *inner, pad_to), fill, sample.dtype)
+    valid = np.zeros((total, pad_to), bool)
+    for i, a in enumerate(arrays):
+        n = a.shape[-1]
+        if n > pad_to:
+            raise ValueError(f"shard {i} length {n} > pad_to {pad_to}")
+        stacked[i, ..., :n] = a
+        valid[i, :n] = True
+    return (
+        stacked.reshape(w, r, *inner, pad_to),
+        valid.reshape(w, r, pad_to),
+    )

@@ -1,0 +1,63 @@
+"""Smoke-harness test: short load run against a real HTTP server must
+meet the k6-style thresholds (reference: integration/bench/load_test.go
+driving smoke_test.js)."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+from smoke import HTTPTarget, SmokeStats, Thresholds, run_smoke  # noqa: E402
+
+from tempo_tpu.api.server import TempoServer
+from tempo_tpu.app import App, AppConfig
+from tempo_tpu.db import DBConfig
+from tempo_tpu.modules.ingester import IngesterConfig
+
+
+@pytest.fixture
+def served_app(tmp_path):
+    cfg = AppConfig(
+        db=DBConfig(
+            backend="local",
+            backend_path=str(tmp_path / "blocks"),
+            wal_path=str(tmp_path / "wal"),
+        ),
+        ingester=IngesterConfig(max_trace_idle_s=0.2, flush_check_period_s=0.2),
+        generator_enabled=False,
+    )
+    app = App(cfg)
+    app.start_loops()
+    srv = TempoServer(app).start()
+    yield app, srv
+    srv.stop()
+    app.shutdown()
+
+
+def test_smoke_over_http_meets_thresholds(served_app):
+    _, srv = served_app
+    result = run_smoke(
+        HTTPTarget(srv.url),
+        duration_s=5.0,
+        writers=2,
+        readers=2,
+        spans_per_trace=4,
+        read_lag_s=0.5,
+    )
+    assert result["writes"] > 10 and result["reads"] > 10
+    assert result["passed"], result
+
+
+def test_thresholds_fail_on_bad_rates():
+    st = SmokeStats()
+    for _ in range(100):
+        st.record("write", True, 0.01)
+    for _ in range(80):
+        st.record("read", True, 0.01)
+    for _ in range(20):
+        st.record("read", False, 0.01, not_found=True)
+    out = st.summary(Thresholds())
+    assert out["read_success_rate"] == 0.8
+    assert not out["passed"]

@@ -1,0 +1,217 @@
+"""Load/smoke harness with pass/fail thresholds.
+
+Reference: integration/bench (k6 in Docker against all-in-one + minio;
+smoke_test.js thresholds — write success >99%, read success >90%,
+p99 < 1.5s; stress_test_write_path.js VU ramp). This is the same
+harness in-process python: concurrent writer/reader "virtual users"
+against any tempo-tpu HTTP endpoint, with the same threshold contract
+and a one-line JSON verdict.
+
+Usage:
+  python tools/smoke.py --url http://localhost:3200 --duration 30 --writers 4 --readers 2
+  (or import run_smoke() — the test suite drives it against an in-process app)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Thresholds:
+    """smoke_test.js:39-45 contract."""
+
+    write_success_rate: float = 0.99
+    read_success_rate: float = 0.90
+    p99_latency_s: float = 1.5
+
+
+@dataclass
+class SmokeStats:
+    writes_ok: int = 0
+    writes_failed: int = 0
+    reads_ok: int = 0
+    reads_failed: int = 0
+    reads_not_found: int = 0
+    latencies: list = field(default_factory=list)
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def record(self, kind: str, ok: bool, dt: float, not_found: bool = False):
+        with self.lock:
+            self.latencies.append(dt)
+            if kind == "write":
+                if ok:
+                    self.writes_ok += 1
+                else:
+                    self.writes_failed += 1
+            else:
+                if ok:
+                    self.reads_ok += 1
+                elif not_found:
+                    self.reads_not_found += 1
+                else:
+                    self.reads_failed += 1
+
+    def summary(self, th: Thresholds) -> dict:
+        with self.lock:
+            lat = sorted(self.latencies)
+        writes = self.writes_ok + self.writes_failed
+        # not-found reads count against read success (the reference's
+        # read checks require the written trace to come back)
+        reads = self.reads_ok + self.reads_failed + self.reads_not_found
+        p99 = lat[int(len(lat) * 0.99)] if lat else 0.0
+        write_rate = self.writes_ok / writes if writes else 1.0
+        read_rate = self.reads_ok / reads if reads else 1.0
+        return {
+            "writes": writes,
+            "write_success_rate": round(write_rate, 4),
+            "reads": reads,
+            "read_success_rate": round(read_rate, 4),
+            "p99_latency_s": round(p99, 4),
+            "passed": (
+                write_rate >= th.write_success_rate
+                and read_rate >= th.read_success_rate
+                and p99 <= th.p99_latency_s
+            ),
+        }
+
+
+class HTTPTarget:
+    """Drives a live endpoint (the k6 shape)."""
+
+    def __init__(self, base_url: str):
+        from tempo_tpu.backend.httpclient import HTTPError, PooledHTTPClient
+
+        self.client = PooledHTTPClient(base_url, max_retries=0)
+        self.HTTPError = HTTPError
+
+    def write(self, traces) -> bool:
+        from tempo_tpu.receivers import otlp
+
+        status, _, _ = self.client.request(
+            "POST",
+            "/v1/traces",
+            headers={"Content-Type": "application/x-protobuf"},
+            body=otlp.encode_traces_request(traces),
+            ok=(200,),
+        )
+        return status == 200
+
+    def read(self, trace_id: bytes):
+        """-> 'ok' | 'notfound' | 'error'"""
+        try:
+            self.client.request(
+                "GET",
+                f"/api/traces/{trace_id.hex()}",
+                headers={"Accept": "application/protobuf"},
+                ok=(200,),
+            )
+            return "ok"
+        except self.HTTPError as e:
+            return "notfound" if e.status == 404 else "error"
+        except Exception:
+            return "error"
+
+
+class InProcessTarget:
+    def __init__(self, app):
+        self.app = app
+
+    def write(self, traces) -> bool:
+        self.app.push_traces(traces)
+        return True
+
+    def read(self, trace_id: bytes):
+        try:
+            return "ok" if self.app.find_trace(trace_id) is not None else "notfound"
+        except Exception:
+            return "error"
+
+
+def run_smoke(
+    target,
+    duration_s: float = 10.0,
+    writers: int = 2,
+    readers: int = 2,
+    spans_per_trace: int = 5,
+    thresholds: Thresholds | None = None,
+    read_lag_s: float = 1.0,
+) -> dict:
+    from tempo_tpu.model import synth
+
+    th = thresholds or Thresholds()
+    stats = SmokeStats()
+    written: list = []  # (time, trace_id)
+    written_lock = threading.Lock()
+    stop = threading.Event()
+
+    def writer(seed: int):
+        rng = random.Random(seed)
+        i = 0
+        while not stop.is_set():
+            traces = synth.make_traces(
+                2, seed=seed * 1_000_000 + i, spans_per_trace=spans_per_trace
+            )
+            i += 1
+            t0 = time.monotonic()
+            try:
+                ok = target.write(traces)
+            except Exception:
+                ok = False
+            stats.record("write", ok, time.monotonic() - t0)
+            if ok:
+                with written_lock:
+                    for t in traces:
+                        written.append((time.monotonic(), t.trace_id))
+            time.sleep(rng.uniform(0.005, 0.02))
+
+    def reader(seed: int):
+        rng = random.Random(seed)
+        while not stop.is_set():
+            with written_lock:
+                eligible = [w for w in written if time.monotonic() - w[0] >= read_lag_s]
+            if not eligible:
+                time.sleep(0.05)
+                continue
+            _, tid = rng.choice(eligible)
+            t0 = time.monotonic()
+            outcome = target.read(tid)
+            stats.record("read", outcome == "ok", time.monotonic() - t0,
+                         not_found=outcome == "notfound")
+            time.sleep(rng.uniform(0.005, 0.02))
+
+    threads = [threading.Thread(target=writer, args=(i,), daemon=True) for i in range(writers)]
+    threads += [threading.Thread(target=reader, args=(100 + i,), daemon=True) for i in range(readers)]
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    return stats.summary(th)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--url", required=True)
+    p.add_argument("--duration", type=float, default=30.0)
+    p.add_argument("--writers", type=int, default=4)
+    p.add_argument("--readers", type=int, default=2)
+    args = p.parse_args(argv)
+    result = run_smoke(
+        HTTPTarget(args.url), duration_s=args.duration,
+        writers=args.writers, readers=args.readers,
+    )
+    print(json.dumps(result))
+    return 0 if result["passed"] else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
