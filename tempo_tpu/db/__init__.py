@@ -50,6 +50,11 @@ class DBConfig:
     build_tenant_index: bool = False
     stale_tenant_index_s: float = 0.0
     max_spans_per_trace: int = 0
+    # >1: compaction tiles are ID-range-sharded over this many local
+    # devices and block sketches merge with psum/pmax over ICI
+    # (encoding/vtpu/compactor.py); 0 = all local devices when more than
+    # one is attached, 1 = force single-device/host merge
+    compaction_device_shards: int = 0
 
 
 class TempoDB:
@@ -95,6 +100,7 @@ class TempoDB:
         self._stop = threading.Event()
         self.last_poll = 0.0
         self._wal = None
+        self._compaction_mesh = False  # False = not yet resolved
 
     @property
     def wal(self):
@@ -121,7 +127,26 @@ class TempoDB:
         return CompactionOptions(
             block_config=self.cfg.block,
             max_spans_per_trace=self.cfg.max_spans_per_trace,
+            mesh=self.compaction_mesh(),
         )
+
+    def compaction_mesh(self):
+        """Device mesh for sharded compaction, or None (lazy: jax is only
+        imported when the knob asks for devices)."""
+        if self._compaction_mesh is False:
+            n = self.cfg.compaction_device_shards
+            mesh = None
+            if n != 1:
+                import jax
+
+                from tempo_tpu.parallel.mesh import compaction_mesh
+
+                avail = len(jax.devices())
+                want = avail if n == 0 else min(n, avail)
+                if want > 1:
+                    mesh = compaction_mesh(want)
+            self._compaction_mesh = mesh
+        return self._compaction_mesh
 
     # ------------------------------------------------------------------
     # writer

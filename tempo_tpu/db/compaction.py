@@ -108,6 +108,7 @@ class CompactionMetrics:
     objects_written: int = 0
     bytes_written: int = 0
     spans_dropped: int = 0
+    spans_combined: int = 0
     errors: int = 0
 
 
@@ -174,4 +175,5 @@ class CompactionDriver:
         self.metrics.objects_written += sum(m.total_objects for m in new_metas)
         self.metrics.bytes_written += sum(m.size_bytes for m in new_metas)
         self.metrics.spans_dropped += getattr(compactor, "spans_dropped", 0)
+        self.metrics.spans_combined += getattr(compactor, "spans_combined", 0)
         return new_metas

@@ -46,6 +46,11 @@ class CompactionOptions:
     #  are spans)
     max_spans_per_trace: int = 0
     on_spans_dropped: object = None  # callback(n_dropped)
+    # jax.sharding.Mesh for device-sharded compaction: tiles are split
+    # into uniform trace-ID ranges across the mesh and block sketches
+    # merge with psum/pmax over ICI (encoding/vtpu/compactor.py
+    # _ShardedTileMerger). None = host/native or single-device merge.
+    mesh: object = None
 
 
 @dataclass

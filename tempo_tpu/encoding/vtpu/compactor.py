@@ -1,19 +1,38 @@
-"""Block compactor: k blocks -> 1 block via device sort/dedupe/gather.
+"""Block compactor: k blocks -> 1 block, streamed through bounded tiles.
 
 Reference analog: tempodb/encoding/vparquet/compactor.go:31-215 — k-way
-bookmark merge of parquet rows, object reconstruct+combine on ID
-collision, row pooling, GC calls. Here the whole merge is three device
-steps (ops.merge.merge_spans): lexsort all span rows by (traceID,
-spanID), mask duplicate rows, gather survivors — then stream the merged
-batch back out through the block writer.
+bookmark merge of parquet rows that never materializes a whole block
+(row groups are flushed at RowGroupSizeBytes, compactor.go:160-188), and
+a combine closure that dedupes byte-equal rows but merges rows that
+share an ID with differing payload (compactor.go:76-127).
 
-Memory note: inputs are materialized per *row group* then concatenated;
-for very large jobs the driver bounds input size via
-CompactionOptions/max block sizes picked by the block selector
-(tempodb/compaction_block_selector.go caps). A fully streamed variant
-(window the sorted stream through fixed-size device tiles) slots in
-behind the same interface; parallel/compaction.py shards block ranges
-across devices first, which divides per-shard working sets.
+TPU-first shape of the same job:
+
+- **Streaming**: each input block is a sorted stream of row groups. Per
+  round, the merge loads at most one new row group per input block,
+  takes the rows strictly below the *safe boundary* (the minimum of the
+  per-stream last-loaded keys — any unloaded row anywhere sorts after
+  it), merges that tile, and hands complete traces to the block writer,
+  which flushes output row groups as they fill. Peak resident rows are
+  O(k x row_group_spans), independent of job size.
+- **Tile merge on device**: the per-tile sort/dedupe is `ops.merge`
+  (lexsort over 128-bit trace-ID + span-ID limbs, first-occurrence
+  mask). With a multi-device mesh (CompactionOptions.mesh) the tile is
+  partitioned into uniform trace-ID ranges (parallel/compaction.py),
+  each device merges its shard, and the block's bloom/HLL/count-min
+  sketches are merged across shards with psum/pmax over ICI — the
+  BASELINE.json north-star collective, accumulated tile-over-tile into
+  the final block sketches (bloom OR, HLL max, CM add are associative,
+  so tile partials compose exactly).
+- **Host fast path**: without a mesh, the native C++ k-way bookmark
+  merge plans the order in one linear pass off the GIL; the device
+  lexsort is the fallback when the .so is absent.
+- **Combine**: duplicate (traceID, spanID) runs are not first-wins
+  dropped. The survivor is the run member with the richest payload
+  (max duration, then attr count), the attrs of all members are
+  unioned onto it, and runs whose members actually differ are counted
+  in `spans_combined` (reference: Combine in
+  modules/compactor/compactor.go:219 + vparquet/compactor.go:76-127).
 """
 
 from __future__ import annotations
@@ -23,87 +42,471 @@ import numpy as np
 import jax.numpy as jnp
 
 from tempo_tpu.backend.base import BlockMeta, TypedBackend
-from tempo_tpu.encoding.common import BlockConfig, CompactionOptions
-from tempo_tpu.encoding.vtpu import format as fmt
+from tempo_tpu.encoding.common import CompactionOptions
 from tempo_tpu.encoding.vtpu.block import VtpuBackendBlock
 from tempo_tpu.encoding.vtpu.create import write_block
-from tempo_tpu.model.columnar import ATTR_COLUMNS, SPAN_COLUMNS, SpanBatch
+from tempo_tpu.model.columnar import (
+    ATTR_COLUMNS,
+    CODE_COLUMNS,
+    SPAN_COLUMNS,
+    Dictionary,
+    SpanBatch,
+)
 from tempo_tpu import native
-from tempo_tpu.ops import merge
+from tempo_tpu.ops import bloom, merge, sketch
+
+# span columns whose values can legitimately differ between RF copies of
+# the same span; trace_id/span_id are the identity key.
+_PAYLOAD_COLS = [c for c in SPAN_COLUMNS if c not in ("trace_id", "span_id")]
 
 
 class VtpuCompactor:
     def __init__(self, opts: CompactionOptions | None = None):
         self.opts = opts or CompactionOptions()
         self.spans_dropped = 0
+        self.spans_combined = 0
+        # resident-row high-water mark (stream buffers + tile), for the
+        # bounded-memory contract tests
+        self.max_resident_rows = 0
 
+    # ------------------------------------------------------------------
     def compact(self, metas: list[BlockMeta], tenant: str, backend: TypedBackend) -> list[BlockMeta]:
         """Merge input blocks; returns metas of output blocks (1 today)."""
-        cfg = self.opts.block_config
-        parts = []
-        block_rows = []  # rows per input block, for the streaming merge plan
-        for m in metas:
-            blk = VtpuBackendBlock(m, backend, cfg)
-            rows = 0
-            for rg in blk.index().row_groups:
-                cols = blk.read_columns(rg, list(SPAN_COLUMNS))
-                attrs = blk.read_columns(rg, list(ATTR_COLUMNS))
-                parts.append(SpanBatch(cols=cols, attrs=attrs, dictionary=blk.dictionary()))
-                rows += cols["trace_id"].shape[0]
-            block_rows.append(rows)
-        if not parts:
+        if not metas:
             return []
-        big = SpanBatch.concat(parts)
-
-        order = _merge_order(big, block_rows)
-        merged = big.select(order)
-
-        if self.opts.max_spans_per_trace:
-            merged, dropped = _cap_spans_per_trace(merged, self.opts.max_spans_per_trace)
-            self.spans_dropped += dropped
-            if dropped and self.opts.on_spans_dropped:
-                self.opts.on_spans_dropped(dropped)
+        cfg = self.opts.block_config
+        out_dict = Dictionary()
+        streams = [
+            _BlockStream(VtpuBackendBlock(m, backend, cfg), out_dict) for m in metas
+        ]
+        sharded = _ShardedTileMerger.build(self.opts, metas) if self.opts.mesh is not None else None
 
         level = max(m.compaction_level for m in metas) + 1
-        out = write_block([merged], tenant, backend, cfg, compaction_level=level)
+        batches = self._stream_merge(streams, out_dict, sharded)
+        out = write_block(
+            batches, tenant, backend, cfg, compaction_level=level,
+            sketches=(sharded.finish if sharded else None),
+        )
         return [out] if out else []
 
+    # ------------------------------------------------------------------
+    def _stream_merge(self, streams, out_dict, sharded):
+        """Generator of merged, trace-complete SpanBatches in ID order."""
+        target = self.opts.block_config.row_group_spans
+        buffers: list[SpanBatch | None] = [None] * len(streams)
+        pending: list[SpanBatch] = []
+        pending_rows = 0
 
-def _merge_order(big: SpanBatch, block_rows: list[int]) -> np.ndarray:
-    """Surviving row indices of `big` in global (traceID, spanID) order.
+        while True:
+            for i, s in enumerate(streams):
+                if (buffers[i] is None or buffers[i].num_spans == 0) and not s.exhausted():
+                    buffers[i] = s.next_batch()
+            live = [i for i in range(len(streams)) if buffers[i] is not None and buffers[i].num_spans > 0]
+            if not live:
+                break
+            open_streams = [i for i in live if not streams[i].exhausted()]
 
-    Fast path: each input block's rows are already sorted (block storage
-    order), so the native C++ k-way bookmark merge plans the global
-    order in one linear host pass off the GIL — no device-wide re-sort
-    (reference analog: the bookmark merge in
-    vparquet/multiblock_iterator.go). Falls back to the device
-    lexsort/dedupe plan (ops.merge.merge_spans) when the native library
-    isn't built.
+            parts: list[SpanBatch] = []
+            if open_streams:
+                boundary = min(_last_key(buffers[i]) for i in open_streams)
+                for i in live:
+                    cut = _count_below(buffers[i], boundary)
+                    if cut:
+                        parts.append(_slice_rows(buffers[i], 0, cut))
+                        buffers[i] = _slice_rows(buffers[i], cut, buffers[i].num_spans)
+                # progress: streams pinned at the boundary pull their next
+                # row group so the boundary advances next round
+                for i in open_streams:
+                    if _last_key(buffers[i]) == boundary and not streams[i].exhausted():
+                        nxt = streams[i].next_batch()
+                        buffers[i] = _concat_shared([buffers[i], nxt], out_dict)
+            else:
+                # final round: everything left is safe
+                for i in live:
+                    parts.append(buffers[i])
+                    buffers[i] = None
+
+            resident = sum(b.num_spans for b in buffers if b is not None)
+            resident += sum(p.num_spans for p in parts) + pending_rows
+            self.max_resident_rows = max(self.max_resident_rows, resident)
+
+            if parts:
+                tile = _concat_shared(parts, out_dict)
+                run_lengths = [p.num_spans for p in parts]
+                merged = self._merge_tile(tile, run_lengths, sharded)
+                if merged.num_spans:
+                    pending.append(merged)
+                    pending_rows += merged.num_spans
+
+            final = not any(
+                (buffers[i] is not None and buffers[i].num_spans) or not streams[i].exhausted()
+                for i in range(len(streams))
+            )
+            if pending and (final or pending_rows >= target):
+                pend = _concat_shared(pending, out_dict) if len(pending) > 1 else pending[0]
+                if final:
+                    emit, rest = pend, None
+                else:
+                    # hold back the trailing trace — later rounds may merge
+                    # more of its spans (only the last trace can grow: all
+                    # future keys are >= the safe boundary)
+                    firsts, _ = pend.trace_boundaries()
+                    cut = int(firsts[-1])
+                    if cut == 0:
+                        pending, pending_rows = [pend], pend.num_spans
+                        continue
+                    emit = _slice_rows(pend, 0, cut)
+                    rest = _slice_rows(pend, cut, pend.num_spans)
+                pending = [rest] if rest is not None and rest.num_spans else []
+                pending_rows = sum(p.num_spans for p in pending)
+                if self.opts.max_spans_per_trace:
+                    emit, dropped = _cap_spans_per_trace(emit, self.opts.max_spans_per_trace)
+                    self.spans_dropped += dropped
+                    if dropped and self.opts.on_spans_dropped:
+                        self.opts.on_spans_dropped(dropped)
+                if emit.num_spans:
+                    yield emit
+            if final:
+                break
+
+    # ------------------------------------------------------------------
+    def _merge_tile(self, tile: SpanBatch, run_lengths: list[int], sharded) -> SpanBatch:
+        if sharded is not None:
+            order, keep = sharded.merge(tile)
+        else:
+            order, keep = _plan_order_host(
+                tile, run_lengths, self.opts.block_config.bucket_for
+            )
+        batch, combined = _combine_duplicates(tile, order, keep)
+        self.spans_combined += combined
+        return batch
+
+
+# ---------------------------------------------------------------------------
+# input streams
+# ---------------------------------------------------------------------------
+
+
+class _BlockStream:
+    """Sorted row-group stream of one input block, with its dictionary
+    codes remapped onto the shared output dictionary (one remap table per
+    block — a block has a single dictionary — applied as vectorized
+    gathers per row group)."""
+
+    def __init__(self, block: VtpuBackendBlock, out_dict: Dictionary):
+        self.block = block
+        self.rgs = list(block.index().row_groups)
+        self.pos = 0
+        self.remap = block.dictionary().remap_onto(out_dict)
+        self.out_dict = out_dict
+
+    def exhausted(self) -> bool:
+        return self.pos >= len(self.rgs)
+
+    def next_batch(self) -> SpanBatch:
+        rg = self.rgs[self.pos]
+        self.pos += 1
+        cols = self.block.read_columns(rg, list(SPAN_COLUMNS))
+        attrs = self.block.read_columns(rg, list(ATTR_COLUMNS))
+        for k in CODE_COLUMNS:
+            cols[k] = self.remap[cols[k]]
+        attrs["attr_key"] = self.remap[attrs["attr_key"]]
+        is_str = attrs["attr_vtype"] == 0  # VT_STR
+        attrs["attr_str"] = np.where(is_str, self.remap[attrs["attr_str"]], attrs["attr_str"]).astype(np.uint32)
+        return SpanBatch(cols=cols, attrs=attrs, dictionary=self.out_dict)
+
+
+def _concat_shared(batches: list[SpanBatch], out_dict: Dictionary) -> SpanBatch:
+    """Concat batches that already share `out_dict` (no remapping)."""
+    batches = [b for b in batches if b.num_spans > 0]
+    if not batches:
+        return SpanBatch(dictionary=out_dict)
+    if len(batches) == 1:
+        return batches[0]
+    cols = {k: np.concatenate([b.cols[k] for b in batches]) for k in SPAN_COLUMNS}
+    attrs = {}
+    base = 0
+    owners = []
+    for b in batches:
+        owners.append(b.attrs["attr_span"] + np.uint32(base))
+        base += b.num_spans
+    attrs["attr_span"] = np.concatenate(owners)
+    for k in ATTR_COLUMNS:
+        if k != "attr_span":
+            attrs[k] = np.concatenate([b.attrs[k] for b in batches])
+    return SpanBatch(cols=cols, attrs=attrs, dictionary=out_dict)
+
+
+def _slice_rows(batch: SpanBatch, lo: int, hi: int) -> SpanBatch:
+    if lo == 0 and hi == batch.num_spans:
+        return batch
+    cols = {k: v[lo:hi] for k, v in batch.cols.items()}
+    o = batch.attrs["attr_span"]
+    amask = (o >= lo) & (o < hi)
+    attrs = {k: v[amask] for k, v in batch.attrs.items()}
+    attrs["attr_span"] = (attrs["attr_span"] - np.uint32(lo)).astype(np.uint32)
+    return SpanBatch(cols=cols, attrs=attrs, dictionary=batch.dictionary)
+
+
+def _key_lanes(batch: SpanBatch):
+    """(hi, mid, lo) uint64 lanes of the (traceID, spanID) sort key."""
+    tid = batch.cols["trace_id"].astype(np.uint64)
+    sid = batch.cols["span_id"].astype(np.uint64)
+    hi = (tid[:, 0] << np.uint64(32)) | tid[:, 1]
+    mid = (tid[:, 2] << np.uint64(32)) | tid[:, 3]
+    lo = (sid[:, 0] << np.uint64(32)) | sid[:, 1]
+    return hi, mid, lo
+
+
+def _last_key(batch: SpanBatch):
+    t = batch.cols["trace_id"][-1]
+    s = batch.cols["span_id"][-1]
+    return (int(t[0]), int(t[1]), int(t[2]), int(t[3]), int(s[0]), int(s[1]))
+
+
+def _count_below(batch: SpanBatch, boundary) -> int:
+    """Rows with key strictly below `boundary` (rows are sorted, so the
+    below-set is a prefix)."""
+    hi, mid, lo = _key_lanes(batch)
+    bhi = (boundary[0] << 32) | boundary[1]
+    bmid = (boundary[2] << 32) | boundary[3]
+    blo = (boundary[4] << 32) | boundary[5]
+    below = (hi < bhi) | ((hi == bhi) & ((mid < bmid) | ((mid == bmid) & (lo < blo))))
+    return int(below.sum())
+
+
+# ---------------------------------------------------------------------------
+# tile merge planning
+# ---------------------------------------------------------------------------
+
+
+def _plan_order_host(tile: SpanBatch, run_lengths: list[int], bucket_for):
+    """Full sorted order + first-occurrence mask for one tile.
+
+    Native C++ k-way bookmark merge over the per-stream sorted runs when
+    the .so is built; device lexsort/dedupe (bucket-padded so XLA
+    compiles a bounded set of shapes) otherwise.
     """
     nat = native.lib()
-    if nat is not None and len(block_rows) > 1:
-        tid = big.cols["trace_id"].astype(np.uint64)
-        sid = big.cols["span_id"].astype(np.uint64)
-        hi_all = (tid[:, 0] << np.uint64(32)) | tid[:, 1]
-        mid_all = (tid[:, 2] << np.uint64(32)) | tid[:, 3]
-        lo_all = (sid[:, 0] << np.uint64(32)) | sid[:, 1]
+    if nat is not None and len(run_lengths) > 1:
+        hi, mid, lo = _key_lanes(tile)
         his, mids, los, bases = [], [], [], []
         off = 0
-        for rows in block_rows:
-            his.append(hi_all[off : off + rows])
-            mids.append(mid_all[off : off + rows])
-            los.append(lo_all[off : off + rows])
+        for rows in run_lengths:
+            his.append(hi[off : off + rows])
+            mids.append(mid[off : off + rows])
+            los.append(lo[off : off + rows])
             bases.append(off)
             off += rows
         stream, row, dup = nat.kway_merge_u192(his, mids, los)
         order = np.asarray(bases, dtype=np.int64)[stream] + row
-        return order[~dup]
-    plan = merge.merge_spans(
-        jnp.asarray(big.cols["trace_id"]), jnp.asarray(big.cols["span_id"])
-    )
-    perm = np.asarray(plan["perm"])
-    keep = np.asarray(plan["keep"])
-    return perm[keep]  # surviving rows in sorted order
+        return order, ~dup
+    n = tile.num_spans
+    pad = bucket_for(n)
+    tids = np.zeros((pad, 4), np.uint32)
+    sids = np.zeros((pad, 2), np.uint32)
+    tids[:n] = tile.cols["trace_id"]
+    sids[:n] = tile.cols["span_id"]
+    valid = np.zeros(pad, bool)
+    valid[:n] = True
+    plan = merge.merge_spans(jnp.asarray(tids), jnp.asarray(sids), jnp.asarray(valid))
+    # invalid rows sort to the end: the first n perm entries are the real rows
+    perm = np.asarray(plan["perm"]).astype(np.int64)[:n]
+    keep = np.asarray(plan["keep"])[:n]
+    return perm, keep
+
+
+class _ShardedTileMerger:
+    """Per-tile mesh-sharded merge + tile-accumulated psum sketches.
+
+    Tiles are partitioned into uniform trace-ID ranges; each device runs
+    the local merge kernel over its shard and the per-shard bloom/HLL/CM
+    partials are merged across the range axis with psum/pmax over ICI
+    (parallel/compaction.py). Because all spans of a trace land in one
+    shard and tiles partition the key space, concatenating shard outputs
+    in shard order yields the globally sorted order, and OR/max/add of
+    tile sketches equals the sketches of the whole block.
+    """
+
+    def __init__(self, mesh, plans, bucket_for):
+        from tempo_tpu.parallel.compaction import make_sharded_compactor
+
+        self.mesh = mesh
+        self.plans = plans
+        self.r = mesh.shape["range"] * mesh.shape["window"]
+        self.bucket_for = bucket_for
+        # reuse the (window=1, range=R) sharded kernel
+        self.step = make_sharded_compactor(mesh, plans)
+        self.bloom_words = None
+        self.hll_regs = None
+        self.cm_counts = None
+
+    @staticmethod
+    def build(opts: CompactionOptions, metas: list[BlockMeta]) -> "_ShardedTileMerger":
+        from tempo_tpu.parallel.compaction import CompactionPlans
+
+        cfg = opts.block_config
+        est_traces = max(1, sum(m.total_objects for m in metas))
+        plans = CompactionPlans(
+            bloom=bloom.plan(est_traces, cfg.bloom_fp, cfg.bloom_shard_size_bytes),
+            hll=sketch.HLLPlan(cfg.hll_precision),
+            cm=sketch.CMPlan(4, 1 << 12),
+        )
+        return _ShardedTileMerger(opts.mesh, plans, cfg.bucket_for)
+
+    def merge(self, tile: SpanBatch):
+        from tempo_tpu.parallel.compaction import partition_by_id_range
+
+        tids = tile.cols["trace_id"]
+        sids = tile.cols["span_id"]
+        # shard sizes first (one bincount) so the tile is partitioned once,
+        # already padded to the kernel shape bucket
+        shard = ((tids[:, 0].astype(np.uint64) * np.uint64(self.r)) >> np.uint64(32)).astype(np.int64)
+        max_shard = int(np.bincount(shard, minlength=self.r).max()) if len(shard) else 1
+        cap = self.bucket_for(max(max_shard, 1))
+        t, s, v, ridx = partition_by_id_range(tids, sids, self.r, pad_to=cap)
+        w = self.mesh.shape["window"]
+        rr = self.mesh.shape["range"]
+        shaped, keepd = self.step(
+            jnp.asarray(t.reshape(w, rr, cap, 4)),
+            jnp.asarray(s.reshape(w, rr, cap, 2)),
+            jnp.asarray(v.reshape(w, rr, cap)),
+        )
+        perm = np.asarray(shaped["perm"]).reshape(self.r, cap)
+        keep = np.asarray(shaped["keep"]).reshape(self.r, cap)
+        n_valid = v.sum(axis=1)
+
+        orders, keeps = [], []
+        for shard in range(self.r):
+            k = int(n_valid[shard])
+            if k == 0:
+                continue
+            p = perm[shard, :k]  # invalid rows sort to the end; prefix is real
+            orders.append(ridx[shard][p])
+            keeps.append(keep[shard, :k])
+        order = np.concatenate(orders) if orders else np.empty(0, np.int64)
+        keepm = np.concatenate(keeps) if keeps else np.empty(0, bool)
+
+        # tile partials -> block sketches. psum/pmax only reduce over the
+        # range axis; with a multi-window mesh each window holds the merge
+        # of its own shard subset, so complete the merge across windows on
+        # host (OR/max/add are associative).
+        tb = np.bitwise_or.reduce(np.asarray(keepd["bloom"]), axis=0)
+        th = np.asarray(keepd["hll"]).max(axis=0)
+        tc = np.asarray(keepd["cm"]).sum(axis=0, dtype=np.uint32)
+        if self.bloom_words is None:
+            self.bloom_words, self.hll_regs, self.cm_counts = tb, th, tc
+        else:
+            self.bloom_words = self.bloom_words | tb
+            self.hll_regs = np.maximum(self.hll_regs, th)
+            self.cm_counts = self.cm_counts + tc
+        return order, keepm
+
+    def finish(self) -> dict:
+        """Block-level sketches for write_block (post all tiles).
+
+        hll_regs/cm_counts ride along for callers beyond write_block
+        (hot-trace detection feeding max_spans_per_trace, bench recall
+        accounting): cm holds psum-merged span counts per trace key.
+        """
+        est = 0.0
+        if self.hll_regs is not None:
+            est = float(sketch.hll_estimate(jnp.asarray(self.hll_regs), self.plans.hll))
+        return {
+            "bloom_plan": self.plans.bloom,
+            "bloom_words": self.bloom_words,
+            "hll_regs": self.hll_regs,
+            "cm_counts": self.cm_counts,
+            "est_distinct": int(est),
+        }
+
+
+# ---------------------------------------------------------------------------
+# duplicate combine
+# ---------------------------------------------------------------------------
+
+
+def _combine_duplicates(batch: SpanBatch, order: np.ndarray, keep_sorted: np.ndarray):
+    """Collapse duplicate (traceID, spanID) runs with combine semantics.
+
+    order: all tile rows in sorted key order; keep_sorted: aligned
+    first-occurrence mask. Returns (merged batch, runs_combined).
+    Reference: vparquet/compactor.go:76-127 (equal rows dedupe fast-path,
+    differing rows reconstruct-and-combine).
+    """
+    n = len(order)
+    if n == 0:
+        return SpanBatch(dictionary=batch.dictionary), 0
+    run_id = np.cumsum(keep_sorted) - 1
+    n_runs = int(run_id[-1]) + 1
+    counts = np.bincount(run_id, minlength=n_runs)
+    if counts.max(initial=0) <= 1:
+        return batch.select(order[keep_sorted]), 0
+
+    rows = order
+    dur = batch.cols["duration_nano"][rows]
+    if batch.num_attrs:
+        nattr_all = np.bincount(batch.attrs["attr_span"], minlength=batch.num_spans)
+    else:
+        nattr_all = np.zeros(batch.num_spans, np.int64)
+    nattr = nattr_all[rows]
+
+    # survivor per run: member with max (duration, attr count); ties keep
+    # the latest input row (deterministic; runs are contiguous in `order`)
+    lex = np.lexsort((np.arange(n), nattr, dur, run_id))
+    surv_pos = lex[np.cumsum(counts) - 1]
+    survivors = rows[np.sort(surv_pos)]  # preserve run (ID) order
+
+    # count runs whose members actually differ (payload or attr count)
+    starts = np.flatnonzero(keep_sorted)
+    first_member = rows[starts][run_id]
+    differs = nattr != nattr_all[first_member]
+    for name in _PAYLOAD_COLS:
+        a, b = batch.cols[name][rows], batch.cols[name][first_member]
+        d = (a != b)
+        differs |= d.any(axis=1) if d.ndim > 1 else d
+    run_differs = np.zeros(n_runs, bool)
+    np.logical_or.at(run_differs, run_id, differs)
+    combined = int((run_differs & (counts > 1)).sum())
+
+    sel = batch.select(survivors)
+    if batch.num_attrs:
+        # union non-survivor members' attrs onto the survivor (new owner =
+        # run index, since `sel` has one row per run in run order)
+        row_to_run = np.full(batch.num_spans, -1, np.int64)
+        row_to_run[rows] = run_id
+        is_surv = np.zeros(batch.num_spans, bool)
+        is_surv[survivors] = True
+        o = batch.attrs["attr_span"].astype(np.int64)
+        take = (~is_surv[o]) & (counts[row_to_run[o]] > 1)
+        if take.any():
+            extra = {k: v[take] for k, v in batch.attrs.items()}
+            extra["attr_span"] = row_to_run[o[take]].astype(np.uint32)
+            attrs = {
+                k: np.concatenate([sel.attrs[k], extra[k]]) for k in ATTR_COLUMNS
+            }
+            attrs = _dedupe_attrs(attrs)
+            sel = SpanBatch(cols=sel.cols, attrs=attrs, dictionary=sel.dictionary)
+    return sel, combined
+
+
+def _dedupe_attrs(attrs: dict) -> dict:
+    """Exact-duplicate attr rows collapse; result sorted by owner."""
+    m = len(attrs["attr_span"])
+    if m == 0:
+        return attrs
+    packed = np.empty((m, 6), np.uint64)
+    packed[:, 0] = attrs["attr_span"]
+    packed[:, 1] = attrs["attr_scope"]
+    packed[:, 2] = attrs["attr_key"]
+    packed[:, 3] = attrs["attr_vtype"]
+    packed[:, 4] = attrs["attr_str"]
+    packed[:, 5] = attrs["attr_num"].view(np.uint64)
+    _, idx = np.unique(packed, axis=0, return_index=True)
+    idx.sort()  # stable original order among unique rows
+    out = {k: v[idx] for k, v in attrs.items()}
+    order = np.argsort(out["attr_span"], kind="stable")
+    return {k: v[order] for k, v in out.items()}
 
 
 def _cap_spans_per_trace(batch: SpanBatch, cap: int) -> tuple[SpanBatch, int]:

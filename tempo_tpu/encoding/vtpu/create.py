@@ -38,10 +38,18 @@ def write_block(
     cfg: BlockConfig,
     block_id: str | None = None,
     compaction_level: int = 0,
+    sketches=None,
 ) -> BlockMeta | None:
     """Write one block from an iterable of trace-sorted SpanBatches in
     nondecreasing trace order (a single batch is the common case; the
-    compactor streams several). Returns None for empty input."""
+    compactor streams several). Returns None for empty input.
+
+    sketches: optional zero-arg callable yielding block-level sketches
+    already computed on device (the sharded compactor's psum/pmax-merged
+    bloom/HLL accumulated per tile) — called after all batches are
+    consumed. When given, trace IDs are only counted, never retained, so
+    peak memory stays bounded by one batch.
+    """
     meta = BlockMeta(tenant_id=tenant, version=cfg.version, compaction_level=compaction_level)
     if block_id:
         meta.block_id = block_id
@@ -49,6 +57,7 @@ def write_block(
     index = fmt.BlockIndex()
     offset = 0
     unique_ids: list[np.ndarray] = []
+    n_traces_total = 0
     n_spans = 0
     start_s, end_s = None, 0
     min_id, max_id = None, None
@@ -62,7 +71,9 @@ def write_block(
         elif batch.dictionary is not dictionary:
             raise ValueError("all batches of one block must share a dictionary")
         firsts, _ = batch.trace_boundaries()
-        unique_ids.append(batch.cols["trace_id"][firsts])
+        n_traces_total += len(firsts)
+        if sketches is None:
+            unique_ids.append(batch.cols["trace_id"][firsts])
         for lo, hi in fmt.row_group_slices(batch, cfg.row_group_spans):
             payload, rg = fmt.serialize_row_group(batch, lo, hi, offset, cfg.codec)
             backend.append_named(meta, DataName, payload)
@@ -74,25 +85,30 @@ def write_block(
             min_id = rg.min_id if min_id is None else min(min_id, rg.min_id)
             max_id = rg.max_id if max_id is None else max(max_id, rg.max_id)
 
-    if not unique_ids:
+    if n_traces_total == 0:
         return None
 
-    ids = np.concatenate(unique_ids)
-    plan = bloom.plan(len(ids), cfg.bloom_fp, cfg.bloom_shard_size_bytes)
-    words = np.asarray(bloom.build(jnp.asarray(ids), plan))
+    if sketches is not None:
+        sk = sketches()
+        plan = sk["bloom_plan"]
+        words = np.asarray(sk["bloom_words"])
+        est = int(sk["est_distinct"])
+    else:
+        ids = np.concatenate(unique_ids)
+        plan = bloom.plan(len(ids), cfg.bloom_fp, cfg.bloom_shard_size_bytes)
+        words = np.asarray(bloom.build(jnp.asarray(ids), plan))
+        hp = sketch.HLLPlan(cfg.hll_precision)
+        regs = sketch.hll_update(sketch.hll_init(hp), jnp.asarray(ids), hp)
+        est = int(float(sketch.hll_estimate(regs, hp)))
     for s in range(plan.n_shards):
         backend.write_named(meta, bloom_name(s), bloom.shard_to_bytes(words[s]))
-
-    hp = sketch.HLLPlan(cfg.hll_precision)
-    regs = sketch.hll_update(sketch.hll_init(hp), jnp.asarray(ids), hp)
-    est = int(float(sketch.hll_estimate(regs, hp)))
 
     backend.write_named(meta, ColumnIndexName, index.to_bytes())
     backend.write_named(meta, DictionaryName, fmt.serialize_dictionary(dictionary))
 
     meta.start_time = int(start_s or 0)
     meta.end_time = int(end_s)
-    meta.total_objects = int(len(ids))
+    meta.total_objects = int(n_traces_total)
     meta.total_spans = int(n_spans)
     meta.size_bytes = offset
     meta.min_id = min_id

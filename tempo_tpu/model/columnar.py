@@ -52,6 +52,10 @@ SPAN_COLUMNS = {
     "http_url": (np.uint32, None),  # dictionary code, 0 when absent
 }
 
+# span columns holding dictionary codes (must be remapped when batches
+# with different dictionaries merge)
+CODE_COLUMNS = ("name", "service", "http_method", "http_url")
+
 ATTR_COLUMNS = {
     "attr_span": (np.uint32, None),  # row index of owning span
     "attr_scope": (np.uint8, None),  # SCOPE_*
@@ -231,7 +235,7 @@ class SpanBatch:
             remap = b.dictionary.remap_onto(target)
             for k in SPAN_COLUMNS:
                 v = b.cols[k]
-                if k in ("name", "service", "http_method", "http_url"):
+                if k in CODE_COLUMNS:
                     v = remap[v]
                 cols_out[k].append(v)
             for k in ATTR_COLUMNS:

@@ -35,3 +35,20 @@ def get_mesh(n_devices: int | None = None) -> Mesh:
     import numpy as np
 
     return Mesh(np.asarray(devs[:n]).reshape(w, r), (WINDOW_AXIS, RANGE_AXIS))
+
+
+def compaction_mesh(n_devices: int | None = None) -> Mesh:
+    """Single-job mesh: one window, all devices on the range axis.
+
+    The engine's compaction driver runs one job at a time (reference:
+    tempodb/compactor.go doCompaction picks one tenant per cycle), so all
+    chips go to ID-range shards of that job and the sketch psum/pmax
+    collectives reduce over the whole mesh.
+    """
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"asked for {n} devices, have {len(devs)}")
+    import numpy as np
+
+    return Mesh(np.asarray(devs[:n]).reshape(1, n), (WINDOW_AXIS, RANGE_AXIS))
