@@ -51,6 +51,9 @@ class CompactionOptions:
     # merge with psum/pmax over ICI (encoding/vtpu/compactor.py
     # _ShardedTileMerger). None = host/native or single-device merge.
     mesh: object = None
+    # tile merge planner when mesh is None: auto (native C++ k-way when
+    # built, else device), native, or device (single-device lexsort)
+    merge_path: str = "auto"
 
 
 @dataclass

@@ -15,7 +15,10 @@ tempodb/encoding/v2/page.go).
 
 from __future__ import annotations
 
+import os
+import threading
 import zlib
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
@@ -27,6 +30,58 @@ DEFAULT_CODEC = "zstd"
 
 class CorruptPage(Exception):
     pass
+
+
+# ---------------------------------------------------------------------------
+# shared codec thread pool — page encode/decode run off the GIL (ctypes),
+# so a pool turns the per-column codec loop into parallel lanes (the
+# reference keeps per-codec reader/writer pools for the same reason,
+# tempodb/encoding/v2/pool.go:96-405). set_threads(1) forces the serial
+# path (used by the single-core CPU benchmark baseline).
+# ---------------------------------------------------------------------------
+
+_pool_lock = threading.Lock()
+_pool: ThreadPoolExecutor | None = None
+_pool_threads = 0  # 0 = auto
+
+
+def set_threads(n: int) -> None:
+    global _pool, _pool_threads
+    with _pool_lock:
+        if _pool is not None:
+            _pool.shutdown(wait=False)
+            _pool = None
+        _pool_threads = n
+
+
+def _threads() -> int:
+    if _pool_threads:
+        return _pool_threads
+    env = os.environ.get("TEMPO_TPU_CODEC_THREADS")
+    if env:
+        return max(1, int(env))
+    return min(8, os.cpu_count() or 1)
+
+
+def pool() -> ThreadPoolExecutor | None:
+    """The shared codec executor, or None in single-thread mode."""
+    global _pool
+    n = _threads()
+    if n <= 1:
+        return None
+    with _pool_lock:
+        if _pool is None:
+            _pool = ThreadPoolExecutor(max_workers=n, thread_name_prefix="codec")
+    return _pool
+
+
+def map_pages(fn, items: list):
+    """Run fn over items on the codec pool (ordered results); serial when
+    the pool is disabled or for trivial batches."""
+    p = pool()
+    if p is None or len(items) <= 1:
+        return [fn(it) for it in items]
+    return list(p.map(fn, items))
 
 
 def best_codec() -> str:
@@ -52,7 +107,10 @@ def encode(arr: np.ndarray, codec: str) -> tuple[bytes, int]:
     if codec == "zstd":
         if nat is None:
             raise ValueError("zstd codec requires the native library (g++ + libzstd)")
-        return nat.compress(raw, "zstd", 3), nat.crc32(raw)
+        # level 1: column pages are hot-path writes (compaction rewrites
+        # every byte); still denser than the snappy the reference's
+        # vParquet columns use
+        return nat.compress(raw, "zstd", 1), nat.crc32(raw)
     raise ValueError(f"unknown codec {codec!r}")
 
 

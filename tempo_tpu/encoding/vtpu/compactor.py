@@ -54,6 +54,7 @@ from tempo_tpu.model.columnar import (
 )
 from tempo_tpu import native
 from tempo_tpu.ops import bloom, merge, sketch
+from tempo_tpu.util.pipeline import ReadAhead, prefetch_iter
 
 # span columns whose values can legitimately differ between RF copies of
 # the same span; trace_id/span_id are the identity key.
@@ -82,11 +83,22 @@ class VtpuCompactor:
         sharded = _ShardedTileMerger.build(self.opts, metas) if self.opts.mesh is not None else None
 
         level = max(m.compaction_level for m in metas) + 1
-        batches = self._stream_merge(streams, out_dict, sharded)
-        out = write_block(
-            batches, tenant, backend, cfg, compaction_level=level,
-            sketches=(sharded.finish if sharded else None),
-        )
+        # merge (device/native) runs on a producer thread, overlapped with
+        # the consumer's encode+write (native codec drops the GIL) —
+        # SURVEY.md 7.4's decode->kernel->encode double buffering
+        batches = prefetch_iter(self._stream_merge(streams, out_dict, sharded), depth=2)
+        try:
+            out = write_block(
+                batches, tenant, backend, cfg, compaction_level=level,
+                sketches=(sharded.finish if sharded else None),
+            )
+        finally:
+            # stop the producer thread + per-stream readahead even when
+            # write/encode fails mid-stream (a long-lived compactor daemon
+            # must not leak a thread per failed job)
+            batches.close()
+            for s in streams:
+                s.close()
         return [out] if out else []
 
     # ------------------------------------------------------------------
@@ -175,7 +187,8 @@ class VtpuCompactor:
             order, keep = sharded.merge(tile)
         else:
             order, keep = _plan_order_host(
-                tile, run_lengths, self.opts.block_config.bucket_for
+                tile, run_lengths, self.opts.block_config.bucket_for,
+                self.opts.merge_path,
             )
         batch, combined = _combine_duplicates(tile, order, keep)
         self.spans_combined += combined
@@ -199,13 +212,14 @@ class _BlockStream:
         self.pos = 0
         self.remap = block.dictionary().remap_onto(out_dict)
         self.out_dict = out_dict
+        # fetch+decode of row group i+1 overlaps the merge of row group i
+        self._ahead = ReadAhead(self._load, len(self.rgs))
 
     def exhausted(self) -> bool:
         return self.pos >= len(self.rgs)
 
-    def next_batch(self) -> SpanBatch:
-        rg = self.rgs[self.pos]
-        self.pos += 1
+    def _load(self, i: int) -> SpanBatch:
+        rg = self.rgs[i]
         cols = self.block.read_columns(rg, list(SPAN_COLUMNS))
         attrs = self.block.read_columns(rg, list(ATTR_COLUMNS))
         for k in CODE_COLUMNS:
@@ -214,6 +228,14 @@ class _BlockStream:
         is_str = attrs["attr_vtype"] == 0  # VT_STR
         attrs["attr_str"] = np.where(is_str, self.remap[attrs["attr_str"]], attrs["attr_str"]).astype(np.uint32)
         return SpanBatch(cols=cols, attrs=attrs, dictionary=self.out_dict)
+
+    def next_batch(self) -> SpanBatch:
+        batch = self._ahead.get(self.pos)
+        self.pos += 1
+        return batch
+
+    def close(self):
+        self._ahead.close()
 
 
 def _concat_shared(batches: list[SpanBatch], out_dict: Dictionary) -> SpanBatch:
@@ -241,9 +263,11 @@ def _slice_rows(batch: SpanBatch, lo: int, hi: int) -> SpanBatch:
     if lo == 0 and hi == batch.num_spans:
         return batch
     cols = {k: v[lo:hi] for k, v in batch.cols.items()}
+    # attr_span is sorted (row-group pages store attrs in owner order and
+    # select/concat preserve it), so the owner range is a contiguous slice
     o = batch.attrs["attr_span"]
-    amask = (o >= lo) & (o < hi)
-    attrs = {k: v[amask] for k, v in batch.attrs.items()}
+    a_lo, a_hi = np.searchsorted(o, [lo, hi])
+    attrs = {k: v[a_lo:a_hi] for k, v in batch.attrs.items()}
     attrs["attr_span"] = (attrs["attr_span"] - np.uint32(lo)).astype(np.uint32)
     return SpanBatch(cols=cols, attrs=attrs, dictionary=batch.dictionary)
 
@@ -280,14 +304,20 @@ def _count_below(batch: SpanBatch, boundary) -> int:
 # ---------------------------------------------------------------------------
 
 
-def _plan_order_host(tile: SpanBatch, run_lengths: list[int], bucket_for):
+def _plan_order_host(tile: SpanBatch, run_lengths: list[int], bucket_for,
+                     path: str = "auto"):
     """Full sorted order + first-occurrence mask for one tile.
 
-    Native C++ k-way bookmark merge over the per-stream sorted runs when
-    the .so is built; device lexsort/dedupe (bucket-padded so XLA
-    compiles a bounded set of shapes) otherwise.
+    path "auto"/"native": native C++ k-way bookmark merge over the
+    per-stream sorted runs when the .so is built; "device" (or no .so):
+    device lexsort/dedupe, bucket-padded so XLA compiles a bounded set
+    of shapes; "numpy": the single-threaded host mirror (the benchmark's
+    CPU-pipeline baseline).
     """
-    nat = native.lib()
+    if path == "numpy":
+        plan = merge.np_merge_spans(tile.cols["trace_id"], tile.cols["span_id"])
+        return plan["perm"].astype(np.int64), plan["keep"]
+    nat = native.lib() if path in ("auto", "native") else None
     if nat is not None and len(run_lengths) > 1:
         hi, mid, lo = _key_lanes(tile)
         his, mids, los, bases = [], [], [], []
@@ -346,7 +376,9 @@ class _ShardedTileMerger:
         from tempo_tpu.parallel.compaction import CompactionPlans
 
         cfg = opts.block_config
-        est_traces = max(1, sum(m.total_objects for m in metas))
+        # bucketed estimate: the bloom plan is a static jit arg, so
+        # bucketing keeps kernel compiles bounded across jobs
+        est_traces = cfg.bucket_for(max(1, sum(m.total_objects for m in metas)))
         plans = CompactionPlans(
             bloom=bloom.plan(est_traces, cfg.bloom_fp, cfg.bloom_shard_size_bytes),
             hll=sketch.HLLPlan(cfg.hll_precision),
@@ -359,12 +391,8 @@ class _ShardedTileMerger:
 
         tids = tile.cols["trace_id"]
         sids = tile.cols["span_id"]
-        # shard sizes first (one bincount) so the tile is partitioned once,
-        # already padded to the kernel shape bucket
-        shard = ((tids[:, 0].astype(np.uint64) * np.uint64(self.r)) >> np.uint64(32)).astype(np.int64)
-        max_shard = int(np.bincount(shard, minlength=self.r).max()) if len(shard) else 1
-        cap = self.bucket_for(max(max_shard, 1))
-        t, s, v, ridx = partition_by_id_range(tids, sids, self.r, pad_to=cap)
+        t, s, v, ridx = partition_by_id_range(tids, sids, self.r, bucket=self.bucket_for)
+        cap = t.shape[1]
         w = self.mesh.shape["window"]
         rr = self.mesh.shape["range"]
         shaped, keepd = self.step(
@@ -444,41 +472,56 @@ def _combine_duplicates(batch: SpanBatch, order: np.ndarray, keep_sorted: np.nda
         return batch.select(order[keep_sorted]), 0
 
     rows = order
-    dur = batch.cols["duration_nano"][rows]
     if batch.num_attrs:
         nattr_all = np.bincount(batch.attrs["attr_span"], minlength=batch.num_spans)
     else:
         nattr_all = np.zeros(batch.num_spans, np.int64)
     nattr = nattr_all[rows]
 
+    # which runs actually differ (payload or attr count)? Equal RF copies
+    # are the overwhelmingly common case (reference fast-path: equal rows
+    # dedupe without reconstruction, vparquet/compactor.go:85-95) — only
+    # members of multi-runs are compared, and only differing runs pay for
+    # survivor selection + attr union.
+    starts = np.flatnonzero(keep_sorted)
+    multi_pos = np.flatnonzero(counts[run_id] > 1)  # sorted-order positions
+    m_rows = rows[multi_pos]
+    m_first = rows[starts][run_id[multi_pos]]
+    differs = nattr[multi_pos] != nattr_all[m_first]
+    for name in _PAYLOAD_COLS:
+        a, b = batch.cols[name][m_rows], batch.cols[name][m_first]
+        d = (a != b)
+        differs |= d.any(axis=1) if d.ndim > 1 else d
+    if batch.num_attrs:
+        # attr CONTENT can diverge even when counts match — compare
+        # order-independent per-span attr fingerprints (xor of per-attr
+        # mix hashes), so {k: "a"} vs {k: "b"} counts as a difference
+        fp = _attr_fingerprint(batch)
+        differs |= fp[m_rows] != fp[m_first]
+    run_differs = np.zeros(n_runs, bool)
+    np.logical_or.at(run_differs, run_id[multi_pos], differs)
+    combined = int(run_differs.sum())
+    if combined == 0:
+        return batch.select(order[keep_sorted]), 0
+
     # survivor per run: member with max (duration, attr count); ties keep
     # the latest input row (deterministic; runs are contiguous in `order`)
+    dur = batch.cols["duration_nano"][rows]
     lex = np.lexsort((np.arange(n), nattr, dur, run_id))
     surv_pos = lex[np.cumsum(counts) - 1]
     survivors = rows[np.sort(surv_pos)]  # preserve run (ID) order
 
-    # count runs whose members actually differ (payload or attr count)
-    starts = np.flatnonzero(keep_sorted)
-    first_member = rows[starts][run_id]
-    differs = nattr != nattr_all[first_member]
-    for name in _PAYLOAD_COLS:
-        a, b = batch.cols[name][rows], batch.cols[name][first_member]
-        d = (a != b)
-        differs |= d.any(axis=1) if d.ndim > 1 else d
-    run_differs = np.zeros(n_runs, bool)
-    np.logical_or.at(run_differs, run_id, differs)
-    combined = int((run_differs & (counts > 1)).sum())
-
     sel = batch.select(survivors)
     if batch.num_attrs:
         # union non-survivor members' attrs onto the survivor (new owner =
-        # run index, since `sel` has one row per run in run order)
+        # run index, since `sel` has one row per run in run order); only
+        # runs that differ take part
         row_to_run = np.full(batch.num_spans, -1, np.int64)
         row_to_run[rows] = run_id
         is_surv = np.zeros(batch.num_spans, bool)
         is_surv[survivors] = True
         o = batch.attrs["attr_span"].astype(np.int64)
-        take = (~is_surv[o]) & (counts[row_to_run[o]] > 1)
+        take = (~is_surv[o]) & run_differs[row_to_run[o]]
         if take.any():
             extra = {k: v[take] for k, v in batch.attrs.items()}
             extra["attr_span"] = row_to_run[o[take]].astype(np.uint32)
@@ -488,6 +531,32 @@ def _combine_duplicates(batch: SpanBatch, order: np.ndarray, keep_sorted: np.nda
             attrs = _dedupe_attrs(attrs)
             sel = SpanBatch(cols=sel.cols, attrs=attrs, dictionary=sel.dictionary)
     return sel, combined
+
+
+def _attr_fingerprint(batch: SpanBatch) -> np.ndarray:
+    """Order-independent uint64 fingerprint of each span's attr multiset.
+
+    Each attr row is mixed (splitmix64-style) over (scope, key, vtype,
+    str, num-bits) and xor-folded into its owner span. Equal attr sets
+    always collide (xor is commutative); unequal sets collide with
+    ~2^-64 probability — acceptable for routing runs to the combine
+    path, since a false "equal" only means keep-one of two copies.
+    """
+    a = batch.attrs
+    h = (
+        a["attr_scope"].astype(np.uint64)
+        ^ (a["attr_key"].astype(np.uint64) << np.uint64(8))
+        ^ (a["attr_vtype"].astype(np.uint64) << np.uint64(40))
+        ^ (a["attr_str"].astype(np.uint64) << np.uint64(16))
+        ^ a["attr_num"].view(np.uint64)
+    )
+    with np.errstate(over="ignore"):
+        h = (h ^ (h >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        h = (h ^ (h >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        h = h ^ (h >> np.uint64(31))
+    out = np.zeros(batch.num_spans, np.uint64)
+    np.bitwise_xor.at(out, a["attr_span"], h)
+    return out
 
 
 def _dedupe_attrs(attrs: dict) -> dict:

@@ -25,10 +25,27 @@ from tempo_tpu.backend.base import (
     TypedBackend,
     bloom_name,
 )
+from functools import lru_cache
+
 from tempo_tpu.encoding.common import BlockConfig
 from tempo_tpu.encoding.vtpu import format as fmt
 from tempo_tpu.model.columnar import SpanBatch
 from tempo_tpu.ops import bloom, sketch
+
+
+@lru_cache(maxsize=64)
+def _sketch_step(plan: "bloom.BloomPlan", hp: "sketch.HLLPlan"):
+    """One fused device call building bloom words + HLL registers —
+    a single dispatch/transfer round trip per block write."""
+    import jax
+
+    @jax.jit
+    def step(ids, valid):
+        words = bloom.build(ids, plan, valid=valid)
+        regs = sketch.hll_update(sketch.hll_init(hp), ids, hp, valid=valid)
+        return words, regs
+
+    return step
 
 
 def write_block(
@@ -95,10 +112,21 @@ def write_block(
         est = int(sk["est_distinct"])
     else:
         ids = np.concatenate(unique_ids)
-        plan = bloom.plan(len(ids), cfg.bloom_fp, cfg.bloom_shard_size_bytes)
-        words = np.asarray(bloom.build(jnp.asarray(ids), plan))
+        # pad IDs to a shape bucket AND size the bloom plan from the
+        # bucket: both the input shape and the plan are static to XLA, so
+        # bucketing both means the kernels compile once per bucket instead
+        # of once per distinct trace count (SURVEY.md 7.4 static shapes; a
+        # fresh XLA compile per block would dwarf the kernel itself). The
+        # slightly larger plan only lowers the FP rate below budget.
+        pad = cfg.bucket_for(len(ids))
+        plan = bloom.plan(pad, cfg.bloom_fp, cfg.bloom_shard_size_bytes)
+        ids_p = np.zeros((pad, ids.shape[1]), ids.dtype)
+        ids_p[: len(ids)] = ids
+        valid = np.zeros(pad, bool)
+        valid[: len(ids)] = True
         hp = sketch.HLLPlan(cfg.hll_precision)
-        regs = sketch.hll_update(sketch.hll_init(hp), jnp.asarray(ids), hp)
+        words_j, regs = _sketch_step(plan, hp)(jnp.asarray(ids_p), jnp.asarray(valid))
+        words = np.asarray(words_j)
         est = int(float(sketch.hll_estimate(regs, hp)))
     for s in range(plan.n_shards):
         backend.write_named(meta, bloom_name(s), bloom.shard_to_bytes(words[s]))

@@ -125,11 +125,22 @@ def serialize_row_group(batch: SpanBatch, lo: int, hi: int, base_offset: int,
     n = hi - lo
     owner = batch.attrs["attr_span"]
     amask = (owner >= lo) & (owner < hi)
+
+    cols: list[tuple[str, np.ndarray]] = []
+    for name in SPAN_COLUMNS:
+        cols.append((name, batch.cols[name][lo:hi]))
+    for name in ATTR_COLUMNS:
+        arr = batch.attrs[name][amask]
+        if name == "attr_span":
+            arr = (arr - np.uint32(lo)).astype(np.uint32)
+        cols.append((name, arr))
+
+    # column pages compress in parallel on the codec pool (the native
+    # codec releases the GIL), then assemble in deterministic order
+    encoded = codec_mod.map_pages(lambda c: codec_mod.encode(c[1], codec), cols)
     payload = bytearray()
     pages: dict[str, PageMeta] = {}
-
-    def put(name: str, arr: np.ndarray):
-        page, crc = codec_mod.encode(arr, codec)
+    for (name, arr), (page, crc) in zip(cols, encoded):
         pages[name] = PageMeta(
             offset=base_offset + len(payload),
             length=len(page),
@@ -139,14 +150,6 @@ def serialize_row_group(batch: SpanBatch, lo: int, hi: int, base_offset: int,
             crc=crc,
         )
         payload.extend(page)
-
-    for name in SPAN_COLUMNS:
-        put(name, batch.cols[name][lo:hi])
-    for name in ATTR_COLUMNS:
-        arr = batch.attrs[name][amask]
-        if name == "attr_span":
-            arr = (arr - np.uint32(lo)).astype(np.uint32)
-        put(name, arr)
 
     t = batch.cols["trace_id"]
     start = int(batch.cols["start_unix_nano"][lo:hi].min()) // 10**9 if n else 0
@@ -171,12 +174,14 @@ def decode_columns(reader, rg: RowGroupMeta, names: list[str]) -> dict[str, np.n
 
     reader: callable (offset, length) -> bytes (ranged backend read).
     """
-    out = {}
-    for name in names:
+    def one(name):
         pm = rg.pages[name]
         page = reader(pm.offset, pm.length)
-        out[name] = codec_mod.decode(page, pm.dtype, pm.shape, pm.codec, pm.crc)
-    return out
+        return codec_mod.decode(page, pm.dtype, pm.shape, pm.codec, pm.crc)
+
+    # fetch+decode in parallel: ranged reads block in the OS/network and
+    # the native codec releases the GIL
+    return dict(zip(names, codec_mod.map_pages(one, list(names))))
 
 
 def row_group_slices(batch: SpanBatch, target_spans: int) -> list[tuple[int, int]]:

@@ -128,12 +128,15 @@ def make_sharded_compactor(mesh, plans: CompactionPlans):
 
 
 def partition_by_id_range(tids: np.ndarray, sids: np.ndarray, r: int,
-                          pad_to: int | None = None):
+                          pad_to: int | None = None, bucket=None):
     """Host-side split of span rows into R uniform trace-ID ranges.
 
     -> (tids (R,N,4), sids (R,N,2), valid (R,N), row_index (R,N) int64)
     row_index maps shard rows back to input rows (-1 for padding) so the
     host can gather payload columns per shard after the device pass.
+    `bucket` (callable cap->padded cap, e.g. BlockConfig.bucket_for)
+    rounds the shard capacity up to a static kernel shape in the same
+    pass, so callers don't partition twice to learn the cap.
     """
     n = tids.shape[0]
     shard = ((tids[:, 0].astype(np.uint64) * np.uint64(r)) >> np.uint64(32)).astype(np.int64)
@@ -144,6 +147,8 @@ def partition_by_id_range(tids: np.ndarray, sids: np.ndarray, r: int,
         if pad_to < cap:
             raise ValueError(f"pad_to={pad_to} < largest shard {cap}")
         cap = pad_to
+    elif bucket is not None:
+        cap = bucket(cap)
     t_out = np.zeros((r, cap, 4), np.uint32)
     s_out = np.zeros((r, cap, 2), np.uint32)
     valid = np.zeros((r, cap), bool)

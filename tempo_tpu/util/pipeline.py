@@ -1,0 +1,94 @@
+"""Producer/consumer overlap utilities for the hot data paths.
+
+SURVEY.md 7.4 names host<->device bandwidth + serial decode->kernel->
+encode chains as the 10x-killer; the reference overlaps these stages
+with async page prefetch (pkg/parquetquery/iters.go:246,
+tempodb/encoding/v2/iterator_prefetch.go) and N flush queues. Python
+equivalents work because the heavy stages release the GIL: native codec
+calls are ctypes (GIL dropped for the C call), device dispatch blocks in
+XLA, and file IO blocks in the OS.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+_SENTINEL = object()
+
+
+def prefetch_iter(iterable, depth: int = 2):
+    """Run `iterable` on a background thread, buffering up to `depth`
+    items ahead of the consumer. Exceptions re-raise at the consumer.
+    Closing the returned generator (or abandoning it) stops the producer
+    thread, so a consumer that fails mid-stream never leaks a thread
+    blocked on a full queue."""
+    q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def run():
+        try:
+            for item in iterable:
+                if not _put(item):
+                    return
+        except BaseException as e:  # propagate into the consuming thread
+            _put((_SENTINEL, e))
+            return
+        _put((_SENTINEL, None))
+
+    t = threading.Thread(target=run, daemon=True, name="prefetch-iter")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if isinstance(item, tuple) and len(item) == 2 and item[0] is _SENTINEL:
+                if item[1] is not None:
+                    raise item[1]
+                return
+            yield item
+    finally:
+        stop.set()
+
+
+class ReadAhead:
+    """One-slot lookahead for a pull-based loader: while the consumer
+    works on item i, a worker thread loads item i+1."""
+
+    def __init__(self, load, n_items: int):
+        self._load = load
+        self._n = n_items
+        self._next = 0
+        self._future = None
+        self._pool = ThreadPoolExecutor(max_workers=1) if n_items > 1 else None
+
+    def _schedule(self):
+        if self._pool is not None and self._next < self._n:
+            i = self._next
+            self._future = self._pool.submit(self._load, i)
+
+    def get(self, i: int):
+        """Items must be requested in order 0..n-1."""
+        if self._future is not None and self._next == i:
+            fut, self._future = self._future, None
+            self._next += 1
+            self._schedule()
+            return fut.result()
+        # cold path (first call or out-of-order): load inline, then look ahead
+        item = self._load(i)
+        self._next = i + 1
+        self._schedule()
+        return item
+
+    def close(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)

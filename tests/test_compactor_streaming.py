@@ -139,6 +139,26 @@ class TestCombineSemantics:
         has_extra = (merged.attrs["attr_key"] == k).sum()
         assert has_extra == merged.num_spans  # one per span
 
+    def test_attr_value_only_divergence_is_combined(self, backend):
+        """Same span payload + same attr COUNT but one attr value differs:
+        must route to the combine path and union both values."""
+        cfg = BlockConfig()
+        traces = synth.make_traces(5, seed=3, spans_per_trace=3)
+        b1 = tr.traces_to_batch(traces).sorted_by_trace()
+        b2 = tr.traces_to_batch(traces).sorted_by_trace()
+        k = b2.dictionary.add("DIVERGED-VALUE")
+        assert b2.attrs["attr_vtype"][0] == 0  # string-typed
+        b2.attrs["attr_str"][0] = k
+        m1 = enc().create_block([b1], "t", backend, cfg)
+        m2 = enc().create_block([b2], "t", backend, cfg)
+        comp = VtpuCompactor(CompactionOptions(block_config=cfg))
+        (out,) = comp.compact([m1, m2], "t", backend)
+        assert comp.spans_combined >= 1
+        merged = read_all_rows(backend, out, cfg)
+        code = merged.dictionary.get("DIVERGED-VALUE")
+        assert code is not None
+        assert (merged.attrs["attr_str"] == code).any(), "diverged attr value lost"
+
     def test_equal_duplicates_dedupe_without_combine(self, backend):
         cfg = BlockConfig()
         traces = synth.make_traces(10, seed=8)
