@@ -114,10 +114,12 @@ def run_engine(backend, cfg, metas, opts_kw) -> dict:
     fp = fp_n = 0
     for (m1, _), out in zip(jobs, outs):
         blk = enc.open_block(out, backend, cfg)
-        idx = blk.index()
+        # sample from the INPUT block: a merge that drops traces must
+        # show up as recall < 1, so never sample from the output
+        in_blk = enc.open_block(m1, backend, cfg)
         tids = np.unique(
-            np.concatenate([blk.read_columns(rg, ["trace_id"])["trace_id"]
-                            for rg in idx.row_groups[:2]]), axis=0)
+            np.concatenate([in_blk.read_columns(rg, ["trace_id"])["trace_id"]
+                            for rg in in_blk.index().row_groups[:2]]), axis=0)
         sample = tids[rng.choice(len(tids), min(RECALL_SAMPLE, len(tids)), replace=False)]
         for limbs in sample:
             tid_bytes = np.asarray(limbs, dtype=">u4").tobytes()
@@ -141,12 +143,16 @@ def run_engine(backend, cfg, metas, opts_kw) -> dict:
             fp_n += len(rows)
 
     spans_in = sum(m.total_spans for m in metas)
+    fp_rate = fp / max(fp_n, 1)
+    if fp_rate > 2 * cfg.bloom_fp:  # 2x margin for sampling noise
+        print(f"[bench] WARNING: bloom fp rate {fp_rate:.4f} exceeds budget "
+              f"{cfg.bloom_fp}", file=sys.stderr)
     return {
         "seconds": dt,
         "blocks_per_s": len(metas) / dt,
         "spans_per_s": spans_in / dt,
         "recall": found / max(tested, 1),
-        "bloom_fp_rate": fp / max(fp_n, 1),
+        "bloom_fp_rate": fp_rate,
         "outputs": len(outs),
         "output_spans": sum(o.total_spans for o in outs),
     }

@@ -52,6 +52,16 @@ def main(argv=None) -> int:
     server = TempoServer(
         app, host=cfg.server.http_listen_address, port=cfg.server.http_listen_port
     ).start()
+    grpc_server = None
+    if cfg.server.grpc_listen_port and cfg.target in ("all", "distributor"):
+        from tempo_tpu.receivers.grpc_server import TraceGrpcServer
+
+        grpc_server = TraceGrpcServer(
+            app.push_traces,
+            host=cfg.server.http_listen_address,
+            port=cfg.server.grpc_listen_port,
+        ).start()
+        log.info("OTLP/Jaeger gRPC receiver on :%d", grpc_server.port)
     app.start_loops()
     log.info("tempo-tpu up: target=%s listening on %s", cfg.target, server.url)
 
@@ -64,6 +74,8 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, handle)
     signal.signal(signal.SIGINT, handle)
     stop.wait()
+    if grpc_server is not None:
+        grpc_server.stop()
     server.stop()
     app.shutdown()
     log.info("tempo-tpu stopped cleanly")

@@ -40,6 +40,9 @@ _ENV_RE = re.compile(r"\$\{(\w+)(?::([^}]*))?\}")
 class ServerConfig:
     http_listen_address: str = "127.0.0.1"
     http_listen_port: int = 3200
+    # OTLP/Jaeger gRPC ingest (reference: receiver shim port 4317, the
+    # default protocol of OTel SDKs/collectors); 0 disables
+    grpc_listen_port: int = 0
     log_level: str = "info"
 
 

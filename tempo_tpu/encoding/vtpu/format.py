@@ -123,14 +123,17 @@ def serialize_row_group(batch: SpanBatch, lo: int, hi: int, base_offset: int,
     """
     codec = codec_mod.resolve_codec(codec)
     n = hi - lo
+    # attr_span is sorted by construction (pages store attrs in owner
+    # order; select/concat preserve it), so the row group's attrs are a
+    # contiguous slice found by binary search
     owner = batch.attrs["attr_span"]
-    amask = (owner >= lo) & (owner < hi)
+    a_lo, a_hi = np.searchsorted(owner, [lo, hi])
 
     cols: list[tuple[str, np.ndarray]] = []
     for name in SPAN_COLUMNS:
         cols.append((name, batch.cols[name][lo:hi]))
     for name in ATTR_COLUMNS:
-        arr = batch.attrs[name][amask]
+        arr = batch.attrs[name][a_lo:a_hi]
         if name == "attr_span":
             arr = (arr - np.uint32(lo)).astype(np.uint32)
         cols.append((name, arr))
@@ -158,7 +161,7 @@ def serialize_row_group(batch: SpanBatch, lo: int, hi: int, base_offset: int,
     n_traces = int((tid[1:] != tid[:-1]).any(axis=1).sum()) + 1 if n else 0
     meta = RowGroupMeta(
         n_spans=n,
-        n_attrs=int(amask.sum()),
+        n_attrs=int(a_hi - a_lo),
         min_id=id_to_hex(t[lo]),
         max_id=id_to_hex(t[hi - 1]),
         start_s=start,

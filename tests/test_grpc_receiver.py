@@ -1,0 +1,141 @@
+"""OTLP gRPC + Jaeger gRPC receiver e2e: a real grpcio client exports
+traces into the app (the default OTel SDK flow over port 4317), which
+are then queryable through the engine. Mirrors the receiver coverage of
+integration/e2e/receivers_test.go:35 for the gRPC protocols."""
+
+import numpy as np
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from tempo_tpu.app import App, AppConfig, DEFAULT_TENANT
+from tempo_tpu.db import DBConfig
+from tempo_tpu.model.synth import make_trace
+from tempo_tpu.receivers import otlp, protowire
+from tempo_tpu.receivers.grpc_server import (
+    JAEGER_POST_SPANS_METHOD,
+    OTLP_EXPORT_METHOD,
+    TraceGrpcServer,
+    decode_post_spans_request,
+)
+
+
+@pytest.fixture()
+def served(tmp_path):
+    app = App(
+        AppConfig(
+            db=DBConfig(backend="local", backend_path=str(tmp_path / "b"), wal_path=str(tmp_path / "w"))
+        )
+    )
+    srv = TraceGrpcServer(app.push_traces, host="127.0.0.1", port=0).start()
+    chan = grpc.insecure_channel(f"127.0.0.1:{srv.port}")
+    yield app, srv, chan
+    chan.close()
+    srv.stop()
+    app.shutdown()
+
+
+def _unary(chan, method):
+    return chan.unary_unary(method)  # no serializers: raw bytes in/out
+
+
+def _jaeger_kv(key, vstr):
+    out = bytearray()
+    protowire.put_str_field(out, 1, key)
+    protowire.put_str_field(out, 3, vstr)
+    return bytes(out)
+
+
+def _jaeger_ts(ns):
+    out = bytearray()
+    protowire.put_varint_field(out, 1, ns // 10**9)
+    protowire.put_varint_field(out, 2, ns % 10**9)
+    return bytes(out)
+
+
+def _jaeger_post_spans(trace_id: bytes, span_ids, service="jaeger-svc"):
+    spans = []
+    for i, sid in enumerate(span_ids):
+        s = bytearray()
+        protowire.put_bytes_field(s, 1, trace_id)
+        protowire.put_bytes_field(s, 2, sid)
+        protowire.put_str_field(s, 3, f"op-{i}")
+        if i:
+            ref = bytearray()
+            protowire.put_bytes_field(ref, 2, span_ids[0])
+            protowire.put_varint_field(ref, 3, 0)  # CHILD_OF
+            protowire.put_bytes_field(s, 4, bytes(ref))
+        protowire.put_bytes_field(s, 6, _jaeger_ts(1_700_000_000 * 10**9 + i))
+        protowire.put_bytes_field(s, 7, _jaeger_ts(5 * 10**6))
+        protowire.put_bytes_field(s, 8, _jaeger_kv("region", "eu"))
+        spans.append(bytes(s))
+    process = bytearray()
+    protowire.put_str_field(process, 1, service)
+    protowire.put_bytes_field(process, 2, _jaeger_kv("cluster", "test"))
+    batch = bytearray()
+    protowire.put_bytes_field(batch, 1, bytes(process))
+    for s in spans:
+        protowire.put_bytes_field(batch, 2, s)
+    req = bytearray()
+    protowire.put_bytes_field(req, 1, bytes(batch))
+    return bytes(req)
+
+
+class TestOtlpGrpc:
+    def test_export_lands_and_is_queryable(self, served):
+        app, srv, chan = served
+        trace = make_trace(seed=11, n_spans=5)
+        resp = _unary(chan, OTLP_EXPORT_METHOD)(otlp.encode_traces_request([trace]))
+        assert resp == b""
+        assert srv.requests == 1 and srv.spans == 5
+        got = app.find_trace(trace.trace_id)
+        assert got is not None and got.span_count() == 5
+
+    def test_org_id_metadata_routes_tenant(self, served):
+        app, srv, chan = served
+        trace = make_trace(seed=12, n_spans=3)
+        _unary(chan, OTLP_EXPORT_METHOD)(
+            otlp.encode_traces_request([trace]), metadata=(("x-scope-orgid", "acme"),)
+        )
+        assert app.find_trace(trace.trace_id, org_id="acme") is not None
+
+    def test_bad_payload_invalid_argument(self, served):
+        _, _, chan = served
+        with pytest.raises(grpc.RpcError) as ei:
+            _unary(chan, OTLP_EXPORT_METHOD)(b"\xff\xff\xff not proto")
+        assert ei.value.code() in (
+            grpc.StatusCode.INVALID_ARGUMENT,
+            grpc.StatusCode.INTERNAL,
+        )
+
+    def test_unknown_method_unimplemented(self, served):
+        _, _, chan = served
+        with pytest.raises(grpc.RpcError) as ei:
+            chan.unary_unary("/no.such.Service/Method")(b"")
+        assert ei.value.code() == grpc.StatusCode.UNIMPLEMENTED
+
+
+class TestJaegerGrpc:
+    def test_decode_post_spans(self):
+        tid = b"\x01" * 16
+        sids = [b"\x0a" * 8, b"\x0b" * 8]
+        traces = decode_post_spans_request(_jaeger_post_spans(tid, sids))
+        assert len(traces) == 1
+        t = traces[0]
+        assert t.trace_id == tid and t.span_count() == 2
+        resource, spans = t.batches[0]
+        assert resource["service.name"] == "jaeger-svc"
+        assert resource["cluster"] == "test"
+        child = [s for s in spans if s.span_id == sids[1]][0]
+        assert child.parent_span_id == sids[0]
+        assert child.attributes["region"] == "eu"
+        assert child.duration_nano == 5 * 10**6
+
+    def test_post_spans_lands(self, served):
+        app, srv, chan = served
+        tid = bytes(np.random.default_rng(5).bytes(16))
+        payload = _jaeger_post_spans(tid, [b"\x21" * 8, b"\x22" * 8, b"\x23" * 8])
+        resp = _unary(chan, JAEGER_POST_SPANS_METHOD)(payload)
+        assert resp == b""
+        got = app.find_trace(tid)
+        assert got is not None and got.span_count() == 3
