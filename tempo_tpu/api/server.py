@@ -167,6 +167,35 @@ class _Handler(BaseHTTPRequestHandler):
         qs = parse_qs(url.query)
         app = self.app
 
+        # ring KV service (reference: the memberlist/consul/etcd KV every
+        # ring shares, cmd/tempo/app/modules.go:297-325) — revisioned CAS
+        # + long-poll watch, served by any role
+        if path.startswith("/kv/v1/"):
+            from tempo_tpu.modules import netkv
+
+            name = path[len("/kv/v1/"):]
+            if not name or "/" in name:
+                self._send_error(404, "bad kv name")
+                return 404
+            svc = app.kv_service
+            if method == "GET":
+                wait = qs.get("wait_revision", [None])[0]
+                timeout = float(qs.get("timeout", ["25"])[0])
+                rev, data = svc.read(
+                    name,
+                    wait_revision=int(wait) if wait is not None else None,
+                    timeout_s=min(timeout, 60.0),
+                )
+                self._send_json(200, {"revision": rev, "data": data})
+                return 200
+            doc = json.loads(self._body())
+            ok, cur = svc.cas(name, int(doc["revision"]), doc["data"])
+            if ok:
+                self._send_json(200, {"revision": cur})
+                return 200
+            self._send_json(409, {"revision": cur})
+            return 409
+
         # inter-role RPC (reference: the gRPC services Pusher/Querier +
         # frontend Process stream; here /rpc/v1/* on the same listener)
         if path.startswith("/rpc/"):

@@ -71,9 +71,17 @@ class AppConfig:
     usage_stats: "object | None" = None  # usagestats.UsageStatsConfig
     # -- microservices mode (any target != all) -------------------------
     instance_id: str = ""  # this process's ring identity
-    ring_kv_path: str = ""  # shared ring state file (FileKV); role mode requires it
+    ring_kv_path: str = ""  # shared ring state file (FileKV) for one-host clusters
+    # networked ring KV (reference: memberlist/consul/etcd KV): "local"
+    # serves + uses this process's own /kv/v1 store; an http://host:port
+    # URL points the rings at the serving role. Takes precedence over
+    # ring_kv_path, so multi-node clusters need no shared filesystem.
+    ring_kv_url: str = ""
     advertise_addr: str = ""  # http://host:port other roles reach us at
     frontend_address: str = ""  # queriers: frontend to pull jobs from
+    # ring health: instances missing heartbeats this long are excluded
+    # from replica sets (reference: dskit ring HeartbeatTimeout)
+    ring_heartbeat_timeout_s: float = 60.0
 
 
 class RoleUnavailable(RuntimeError):
@@ -108,6 +116,13 @@ class App:
         self.rpc = None
         self._heartbeat_stops = []
         self._registered: list = []  # (ring, instance_id) to unregister on shutdown
+        # every role serves the ring KV on its HTTP listener; peers point
+        # ring_kv_url at whichever role is designated (reference: one
+        # KVInitService shared by all rings, modules.go:297-325)
+        from tempo_tpu.modules.netkv import KVService
+
+        self.kv_service = KVService()
+        self._net_kvs: list = []
 
         if target == "all":
             self._build_all()
@@ -115,9 +130,24 @@ class App:
             self._build_role(target)
 
     # ------------------------------------------------------------------
+    def _hb_period(self) -> float:
+        return min(10.0, max(0.5, self.cfg.ring_heartbeat_timeout_s / 3))
+
     def _ring_kv(self, suffix: str = ""):
+        if self.cfg.ring_kv_url == "local":
+            from tempo_tpu.modules.netkv import LocalKV
+
+            return LocalKV(self.kv_service, f"ring{suffix}")
+        if self.cfg.ring_kv_url:
+            from tempo_tpu.modules.netkv import HttpKV
+
+            kv = HttpKV(self.cfg.ring_kv_url, f"ring{suffix}")
+            self._net_kvs.append(kv)
+            return kv
         if not self.cfg.ring_kv_path:
-            raise ValueError(f"target={self.target} requires ring_kv_path")
+            raise ValueError(
+                f"target={self.target} requires ring_kv_path or ring_kv_url"
+            )
         return FileKV(self.cfg.ring_kv_path + suffix)
 
     def _instance_id(self, default: str) -> str:
@@ -131,7 +161,8 @@ class App:
         cfg = self.cfg
         self.db = self._make_db()
         kv = MemoryKV()
-        self.ring = Ring(kv, replication_factor=cfg.replication_factor)
+        self.ring = Ring(kv, replication_factor=cfg.replication_factor,
+                         heartbeat_timeout_s=cfg.ring_heartbeat_timeout_s)
 
         for i in range(cfg.n_ingesters):
             iid = f"ingester-{i}"
@@ -145,7 +176,7 @@ class App:
             self.ingesters[iid] = ing
             self.ring.register(iid)
             self._registered.append((self.ring, iid))
-            self._heartbeat_stops.append(self.ring.start_heartbeat(iid))
+            self._heartbeat_stops.append(self.ring.start_heartbeat(iid, period_s=self._hb_period()))
 
         gen_clients = {}
         if cfg.generator_enabled:
@@ -153,7 +184,7 @@ class App:
             self.generator = Generator(self.overrides, instance_id="generator-0")
             self.generator_ring.register("generator-0")
             gen_clients["generator-0"] = self.generator
-            self._heartbeat_stops.append(self.generator_ring.start_heartbeat("generator-0"))
+            self._heartbeat_stops.append(self.generator_ring.start_heartbeat("generator-0", period_s=self._hb_period()))
             if cfg.remote_write is not None and cfg.remote_write.endpoint:
                 self.remote_write_storage = RemoteWriteStorage(cfg.remote_write)
 
@@ -192,10 +223,11 @@ class App:
             self.db = TempoDB(sub_cfg)
             ing = Ingester(self.db, self.overrides, cfg.ingester, instance_id=iid)
             self.ingesters[iid] = ing
-            self.ring = Ring(self._ring_kv(), replication_factor=cfg.replication_factor)
+            self.ring = Ring(self._ring_kv(), replication_factor=cfg.replication_factor,
+                             heartbeat_timeout_s=cfg.ring_heartbeat_timeout_s)
             self.ring.register(iid, addr=cfg.advertise_addr)
             self._registered.append((self.ring, iid))
-            self._heartbeat_stops.append(self.ring.start_heartbeat(iid))
+            self._heartbeat_stops.append(self.ring.start_heartbeat(iid, period_s=self._hb_period()))
             self.rpc = RPCHandler(ingester=ing)
             return
 
@@ -205,14 +237,15 @@ class App:
             self.generator_ring = Ring(self._ring_kv("-generator"), replication_factor=1)
             self.generator_ring.register(gid, addr=cfg.advertise_addr)
             self._registered.append((self.generator_ring, gid))
-            self._heartbeat_stops.append(self.generator_ring.start_heartbeat(gid))
+            self._heartbeat_stops.append(self.generator_ring.start_heartbeat(gid, period_s=self._hb_period()))
             if cfg.remote_write is not None and cfg.remote_write.endpoint:
                 self.remote_write_storage = RemoteWriteStorage(cfg.remote_write)
             self.rpc = RPCHandler(generator=self.generator)
             return
 
         if role == "distributor":
-            self.ring = Ring(self._ring_kv(), replication_factor=cfg.replication_factor)
+            self.ring = Ring(self._ring_kv(), replication_factor=cfg.replication_factor,
+                             heartbeat_timeout_s=cfg.ring_heartbeat_timeout_s)
             gen_clients = {}
             if cfg.generator_enabled:
                 self.generator_ring = Ring(self._ring_kv("-generator"), replication_factor=1)
@@ -234,7 +267,8 @@ class App:
 
         if role == "querier":
             self.db = self._make_db()
-            self.ring = Ring(self._ring_kv(), replication_factor=cfg.replication_factor)
+            self.ring = Ring(self._ring_kv(), replication_factor=cfg.replication_factor,
+                             heartbeat_timeout_s=cfg.ring_heartbeat_timeout_s)
             self.querier = Querier(
                 self.db, self.ring, ingester_clients=RingClientPool(self.ring, RemoteIngester)
             )
@@ -347,6 +381,8 @@ class App:
                 ring.unregister(iid)
             except Exception:
                 log.exception("ring unregister failed for %s", iid)
+        for kv in self._net_kvs:  # after unregister, which needs the KV
+            kv.close()
         if self.remote_worker is not None:
             self.remote_worker.stop()
         for ing in self.ingesters.values():

@@ -168,9 +168,12 @@ def parse_config(text: str, env: dict | None = None) -> Config:
             setattr(app, key, int(doc.pop(key)))
     # microservices-mode identity + discovery (reference: memberlist join
     # config + per-role flags)
-    for key in ("instance_id", "ring_kv_path", "advertise_addr", "frontend_address"):
+    for key in ("instance_id", "ring_kv_path", "ring_kv_url", "advertise_addr",
+                "frontend_address"):
         if key in doc:
             setattr(app, key, str(doc.pop(key)))
+    if "ring_heartbeat_timeout_s" in doc:
+        app.ring_heartbeat_timeout_s = float(doc.pop("ring_heartbeat_timeout_s"))
 
     if doc:
         raise ConfigError(f"{next(iter(doc))}: unknown top-level config key")

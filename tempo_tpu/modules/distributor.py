@@ -162,7 +162,7 @@ class Distributor:
         the reference, distributor.go:483 — here it's one hash over the
         ID columns plus a stable argsort)."""
         tid = batch.cols["trace_id"]
-        tokens = hashing.np_fmix32(hashing.np_fnv1a_32(tid))
+        tokens = hashing.np_token_for_ids(tenant, tid)
         # per unique trace -> replicas, against ONE ring snapshot (the KV
         # re-read + token sort must not run per trace)
         snap = self.ring.snapshot()
@@ -187,7 +187,7 @@ class Distributor:
             return
         # single-assignment by trace token within the shard
         tid = batch.cols["trace_id"]
-        tokens = hashing.np_fmix32(hashing.np_fnv1a_32(tid))
+        tokens = hashing.np_token_for_ids(tenant, tid)
         idx = tokens % np.uint32(len(targets))
         for i, inst in enumerate(targets):
             client = self.generator_clients.get(inst.instance_id)

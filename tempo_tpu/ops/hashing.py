@@ -118,3 +118,25 @@ def token_for(tenant: str, trace_id: bytes) -> int:
     for b in tenant.encode("utf-8") + trace_id:
         h = ((h ^ b) * int(FNV1A_PRIME32)) & 0xFFFFFFFF
     return int(np_fmix32(np.uint32(h)))
+
+
+def np_token_for_ids(tenant: str, limbs: np.ndarray) -> np.ndarray:
+    """Vectorized token_for over (N, 4) trace-ID limbs.
+
+    MUST match token_for byte-for-byte: the distributor places traces
+    with this and the querier reads replicas with token_for — a mismatch
+    silently halves the effective replication factor (each side walks a
+    different replica set).
+    """
+    h0 = int(FNV1A_OFFSET32)
+    for b in tenant.encode("utf-8"):
+        h0 = ((h0 ^ b) * int(FNV1A_PRIME32)) & 0xFFFFFFFF
+    limbs = limbs.astype(np.uint32)
+    h = np.full(limbs.shape[:-1], h0, dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        for i in range(limbs.shape[-1]):
+            w = limbs[..., i]
+            for shift in (24, 16, 8, 0):
+                byte = ((w >> np.uint32(shift)) & np.uint32(0xFF)).astype(np.uint32)
+                h = (h ^ byte) * FNV1A_PRIME32
+    return np_fmix32(h)
