@@ -19,7 +19,7 @@ class BlockConfig:
     bloom_shard_size_bytes: int = 100 * 1024
     # row-group sizing: split at trace boundaries near this many spans
     row_group_spans: int = 1 << 15
-    codec: str = "auto"  # column codec: auto | none | zlib | zstd (auto = zstd when the native C++ lib builds, else zlib)
+    codec: str = "auto"  # column codec: auto | none | zlib | zstd | zstd_shuffle (auto = zstd_shuffle when the native C++ lib builds, else zlib)
     hll_precision: int = 12
     # shape buckets for device kernels: pad-to-power-of-two within [min,max]
     min_device_bucket: int = 1 << 10

@@ -54,7 +54,7 @@ from tempo_tpu.model.columnar import (
 )
 from tempo_tpu import native
 from tempo_tpu.ops import bloom, merge, sketch
-from tempo_tpu.util.pipeline import ReadAhead, prefetch_iter
+from tempo_tpu.util.pipeline import ReadAhead, overlap_enabled, prefetch_iter
 
 # span columns whose values can legitimately differ between RF copies of
 # the same span; trace_id/span_id are the identity key.
@@ -85,8 +85,11 @@ class VtpuCompactor:
         level = max(m.compaction_level for m in metas) + 1
         # merge (device/native) runs on a producer thread, overlapped with
         # the consumer's encode+write (native codec drops the GIL) —
-        # SURVEY.md 7.4's decode->kernel->encode double buffering
-        batches = prefetch_iter(self._stream_merge(streams, out_dict, sharded), depth=2)
+        # SURVEY.md 7.4's decode->kernel->encode double buffering. On a
+        # single-core host the overlap is pure overhead (see
+        # pipeline.overlap_enabled) and the generator runs inline.
+        gen = self._stream_merge(streams, out_dict, sharded)
+        batches = prefetch_iter(gen, depth=2) if overlap_enabled() else gen
         try:
             out = write_block(
                 batches, tenant, backend, cfg, compaction_level=level,

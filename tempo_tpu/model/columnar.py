@@ -156,6 +156,11 @@ class SpanBatch:
 
     def __post_init__(self):
         self.validate()
+        # lazy caches (batches are immutable by convention): trace
+        # boundaries are recomputed by every consumer on the write path
+        # (row-group slicing, block writer, compactor emit) — O(N) each
+        # time over the same rows
+        self._tb_cache: tuple[np.ndarray, np.ndarray] | None = None
 
     @property
     def num_spans(self) -> int:
@@ -217,9 +222,11 @@ class SpanBatch:
 
     def trace_boundaries(self) -> tuple[np.ndarray, np.ndarray]:
         """(first_row_of_each_trace, segment_id_per_span); rows must be
-        sorted by trace."""
-        _, seg, firsts = trace_segmentation(self.cols["trace_id"])
-        return firsts, seg
+        sorted by trace. Cached after the first call."""
+        if self._tb_cache is None:
+            _, seg, firsts = trace_segmentation(self.cols["trace_id"])
+            self._tb_cache = (firsts, seg)
+        return self._tb_cache
 
     @staticmethod
     def concat(batches: list["SpanBatch"]) -> "SpanBatch":

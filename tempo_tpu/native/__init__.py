@@ -71,6 +71,7 @@ def _build() -> str | None:
 class _Binding:
     def __init__(self, so_path: str):
         self.path = so_path
+        self._tls = threading.local()
         lib = ctypes.CDLL(so_path)
         u8p = ctypes.POINTER(ctypes.c_uint8)
         self._crc32 = lib.ttpu_crc32
@@ -102,6 +103,16 @@ class _Binding:
         self._vdec = lib.ttpu_varint_decode_i64
         self._vdec.restype = ctypes.c_longlong
         self._vdec.argtypes = [u8p, ctypes.c_size_t, i64p, ctypes.c_size_t]
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        self._cenc = lib.ttpu_col_encode
+        self._cenc.restype = ctypes.c_longlong
+        self._cenc.argtypes = [u8p, ctypes.c_size_t, ctypes.c_size_t,
+                               ctypes.c_int, ctypes.c_int, u8p,
+                               ctypes.c_size_t, u32p]
+        self._cdec = lib.ttpu_col_decode
+        self._cdec.restype = ctypes.c_longlong
+        self._cdec.argtypes = [u8p, ctypes.c_size_t, ctypes.c_int,
+                               ctypes.c_size_t, u8p, ctypes.c_size_t, u32p]
         self._penc = lib.ttpu_page_encode
         self._penc.restype = ctypes.c_longlong
         self._penc.argtypes = [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t,
@@ -182,7 +193,45 @@ class _Binding:
             raise NativeError(f"decoded {r} elems, expected {n_elems}")
         return out
 
-    PAGE_CODECS = {"none": 0, "zlib": 1, "zstd": 2}
+    PAGE_CODECS = {"none": 0, "zlib": 1, "zstd": 2, "zstd_shuffle": 3}
+
+    def _scratch(self, cap: int) -> np.ndarray:
+        """Per-thread reusable output buffer (page encodes run hot: a
+        fresh np.empty per page costs allocation + page faults)."""
+        buf = getattr(self._tls, "scratch", None)
+        if buf is None or buf.size < cap:
+            buf = np.empty(max(cap, 1 << 20), np.uint8)
+            self._tls.scratch = buf
+        return buf
+
+    def col_encode(self, arr: np.ndarray, codec: str, level: int = 1) -> tuple[bytes, int]:
+        """Fixed-width column -> (page bytes, crc of raw). ONE C call:
+        crc + byte-shuffle + compression, no intermediate Python copies."""
+        arr = np.ascontiguousarray(arr)
+        n = arr.nbytes
+        width = arr.dtype.itemsize
+        cap = int(self._zstd_bound(n)) + 64
+        out = self._scratch(cap)
+        crc = ctypes.c_uint32(0)
+        src = arr.view(np.uint8).reshape(-1) if n else np.empty(0, np.uint8)
+        r = _check(self._cenc(src.ctypes.data_as(self._u8p), n, width,
+                              self.PAGE_CODECS[codec], level,
+                              out.ctypes.data_as(self._u8p), out.size,
+                              ctypes.byref(crc)))
+        return out[:r].tobytes(), int(crc.value)
+
+    def col_decode(self, page: bytes, dtype: str, shape: tuple, codec: str) -> tuple[np.ndarray, int]:
+        """Page bytes -> (array, crc of raw); decompress + unshuffle +
+        crc in one C call, writing straight into the result buffer."""
+        dt = np.dtype(dtype)
+        out = np.empty(shape, dt)
+        n = out.nbytes
+        p, plen = self._buf(page)
+        crc = ctypes.c_uint32(0)
+        dst = out.view(np.uint8).reshape(-1) if n else np.empty(0, np.uint8)
+        _check(self._cdec(p, plen, self.PAGE_CODECS[codec], dt.itemsize,
+                          dst.ctypes.data_as(self._u8p), n, ctypes.byref(crc)))
+        return out, int(crc.value)
 
     def page_encode(self, raw: bytes, codec: str = "zstd", level: int = 3) -> bytes:
         p, n = self._buf(raw)

@@ -11,11 +11,25 @@ XLA, and file IO blocks in the OS.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
 
 _SENTINEL = object()
+
+
+def overlap_enabled() -> bool:
+    """Whether producer/consumer threading can actually overlap work.
+
+    On a single-core host the GIL-released C calls still cannot run
+    concurrently with Python (one core), so background threads only add
+    context switches; measured on the bench workload they cost ~2x.
+    TEMPO_TPU_OVERLAP=0/1 overrides the auto-detect."""
+    env = os.environ.get("TEMPO_TPU_OVERLAP")
+    if env is not None:
+        return env.strip().lower() not in ("0", "false", "no")
+    return (os.cpu_count() or 1) > 1
 
 
 def prefetch_iter(iterable, depth: int = 2):
@@ -69,7 +83,11 @@ class ReadAhead:
         self._n = n_items
         self._next = 0
         self._future = None
-        self._pool = ThreadPoolExecutor(max_workers=1) if n_items > 1 else None
+        self._pool = (
+            ThreadPoolExecutor(max_workers=1)
+            if n_items > 1 and overlap_enabled()
+            else None
+        )
 
     def _schedule(self):
         if self._pool is not None and self._next < self._n:

@@ -122,10 +122,35 @@ def test_codec_zstd_roundtrip_via_native():
     np.testing.assert_array_equal(out, arr)
 
 
-def test_codec_auto_resolves_to_zstd():
-    assert codec.best_codec() == "zstd"
-    assert codec.resolve_codec("auto") == "zstd"
+def test_codec_auto_resolves_to_zstd_shuffle():
+    assert codec.best_codec() == "zstd_shuffle"
+    assert codec.resolve_codec("auto") == "zstd_shuffle"
     assert codec.resolve_codec("zlib") == "zlib"
+
+
+def test_codec_zstd_shuffle_roundtrip_all_widths():
+    rng = np.random.default_rng(3)
+    cases = [
+        rng.integers(0, 2**32, (128, 4)).astype(np.uint32),  # id limbs
+        rng.integers(0, 2**63, 1000).astype(np.uint64),
+        rng.standard_normal(777),  # float64
+        rng.integers(0, 255, 513).astype(np.uint8),  # width 1: no shuffle
+        rng.integers(0, 2**16, 42).astype(np.uint16),
+        np.empty((0,), np.uint32),
+    ]
+    for arr in cases:
+        page, crc = codec.encode(arr, "zstd_shuffle")
+        out = codec.decode(page, arr.dtype.str, arr.shape, "zstd_shuffle", crc)
+        np.testing.assert_array_equal(out, arr)
+
+
+def test_codec_zstd_shuffle_corruption_detected():
+    arr = np.arange(4096, dtype=np.uint64)
+    page, crc = codec.encode(arr, "zstd_shuffle")
+    bad = bytearray(page)
+    bad[len(bad) // 2] ^= 0xFF
+    with pytest.raises(codec.CorruptPage):
+        codec.decode(bytes(bad), arr.dtype.str, arr.shape, "zstd_shuffle", crc)
 
 
 def test_codec_crc_mismatch_raises():
