@@ -73,6 +73,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        if self.close_connection:
+            self.send_header("Connection", "close")
         self.end_headers()
         try:
             self.wfile.write(body)
@@ -83,6 +85,10 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(code, json.dumps(doc).encode())
 
     def _send_error(self, code: int, msg: str) -> None:
+        # error paths may not have drained the request body; keeping the
+        # HTTP/1.1 connection alive would desync the next request on the
+        # socket with the unread bytes
+        self.close_connection = True
         self._send(code, (msg.rstrip("\n") + "\n").encode(), "text/plain; charset=utf-8")
 
     def _org_id(self) -> str | None:
@@ -111,6 +117,14 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802
         self._route("POST")
+
+    # routed so APIs answer 405 (method known, not allowed here) instead
+    # of the stdlib's blanket 501
+    def do_PUT(self):  # noqa: N802
+        self._route("PUT")
+
+    def do_DELETE(self):  # noqa: N802
+        self._route("DELETE")
 
     def _route_template(self, path: str) -> str:
         """Collapse id-bearing paths to templates so metric label
@@ -171,12 +185,13 @@ class _Handler(BaseHTTPRequestHandler):
         # ring shares, cmd/tempo/app/modules.go:297-325) — revisioned CAS
         # + long-poll watch, served by any role
         if path.startswith("/kv/v1/"):
-            from tempo_tpu.modules import netkv
-
             name = path[len("/kv/v1/"):]
             if not name or "/" in name:
                 self._send_error(404, "bad kv name")
                 return 404
+            if method not in ("GET", "POST"):
+                self._send_error(405, "method not allowed")
+                return 405
             svc = app.kv_service
             if method == "GET":
                 wait = qs.get("wait_revision", [None])[0]

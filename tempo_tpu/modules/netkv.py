@@ -157,7 +157,10 @@ class HttpKV(KVStore):
                 with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
                     json.loads(r.read())
                 with self._lock:
-                    self._cache = (rev + 1, new)
+                    # monotonic like the watcher: never clobber a newer
+                    # revision the watch thread stored concurrently
+                    if self._cache is None or self._cache[0] < rev + 1:
+                        self._cache = (rev + 1, new)
                 self._ensure_watcher()
                 return new
             except urllib.error.HTTPError as e:

@@ -29,7 +29,13 @@ def overlap_enabled() -> bool:
     env = os.environ.get("TEMPO_TPU_OVERLAP")
     if env is not None:
         return env.strip().lower() not in ("0", "false", "no")
-    return (os.cpu_count() or 1) > 1
+    try:
+        # affinity-aware: a pinned/cgroup-limited process on a big node
+        # still only has the cpuset it was given
+        usable = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):  # pragma: no cover
+        usable = os.cpu_count() or 1
+    return usable > 1
 
 
 def prefetch_iter(iterable, depth: int = 2):

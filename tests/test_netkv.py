@@ -107,6 +107,32 @@ class TestHttpKV:
         assert reader.get()["v"] == 2, "watch did not refresh the cache"
         writer.close(), reader.close()
 
+    def test_update_never_clobbers_newer_cached_revision(self, kv_server):
+        """A successful CAS must not overwrite a newer revision the
+        watcher thread stored concurrently (regression: update() used to
+        set the cache unconditionally)."""
+        _, srv = kv_server
+        kv = HttpKV(srv.url, "mono", watch=True)
+        kv.update(lambda d: {"v": 1})  # server at revision 1
+        with kv._lock:
+            kv._cache = (999, {"v": "newer"})
+        kv.update(lambda d: {"v": 2})  # CAS lands at revision 2 < 999
+        with kv._lock:
+            assert kv._cache == (999, {"v": "newer"})
+        kv.close()
+
+    def test_kv_route_rejects_unknown_methods(self, kv_server):
+        """DELETE/PUT on /kv/v1/<name> must 405, not fall into the CAS
+        branch and 500 on an empty body."""
+        import urllib.error
+        import urllib.request
+
+        _, srv = kv_server
+        req = urllib.request.Request(f"{srv.url}/kv/v1/ring", method="DELETE")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 405
+
     def test_rings_over_http_kv(self, kv_server):
         """Two rings (processes) sharing the served KV see each other."""
         _, srv = kv_server
