@@ -284,8 +284,48 @@ class TempoDB:
                        end_s: int = 0, limit: int = 20):
         """Execute a TraceQL query over this tenant's blocks (reference:
         traceql.Engine.Execute bridging SearchRequest -> Fetch,
-        pkg/traceql/engine.go:25)."""
-        from tempo_tpu.traceql import execute
+        pkg/traceql/engine.go:25).
+
+        Span-local pipelines run on the VECTORIZED path: per row group,
+        numpy column scans + segment reductions produce per-trace
+        partials; partials merge across blocks (a trace may straddle
+        them) before aggregate filters resolve (traceql/vector.py, the
+        columnar analog of vparquet/block_traceql.go's iterator trees).
+        Structural queries (parent.*, childCount, spanset ops, by,
+        select) take the exact object engine."""
+        from tempo_tpu.traceql import execute, vector
+        from tempo_tpu.traceql.parser import parse
+
+        pipeline = parse(query)
+        metas = [m for m in self.blocklist.metas(tenant) if _overlaps(m, start_s, end_s)]
+        if vector.supports(pipeline) and all(m.version == "vtpu1" for m in metas):
+            def job(meta):
+                blk = self.encoding_for(meta.version).open_block(meta, self.backend, self.cfg.block)
+                local: dict = {}
+                for view, d in blk.iter_eval_views(pipeline, start_s, end_s):
+                    for tid, p in vector.evaluate_batch(pipeline, view, d).items():
+                        if tid in local:
+                            local[tid].merge(p)
+                        else:
+                            local[tid] = p
+                return local
+
+            results, errors = self.pool.run_jobs([lambda m=m: job(m) for m in metas])
+            if any(isinstance(e, vector.Unsupported) for e in errors):
+                # data-shape bailout (e.g. mixed value types for one attr
+                # key): the object engine below answers exactly
+                pass
+            elif errors:
+                raise errors[0]
+            else:
+                partials: dict = {}
+                for local in results:
+                    for tid, p in local.items():
+                        if tid in partials:
+                            partials[tid].merge(p)
+                        else:
+                            partials[tid] = p
+                return vector.finalize(pipeline, partials, limit, start_s, end_s)
 
         def fetch(spec, s, e):
             return self.fetch_candidates(tenant, spec, s, e)

@@ -97,15 +97,21 @@ class TraceSearchMetadata:
     root_trace_name: str = ""
     start_time_unix_nano: int = 0
     duration_ms: int = 0
+    # TraceQL results carry the matched spanset through the frontend
+    # (reference: tempopb.TraceSearchMetadata.SpanSet)
+    span_set: dict | None = None
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "traceID": self.trace_id_hex,
             "rootServiceName": self.root_service_name,
             "rootTraceName": self.root_trace_name,
             "startTimeUnixNano": str(self.start_time_unix_nano),
             "durationMs": self.duration_ms,
         }
+        if self.span_set is not None:
+            d["spanSet"] = self.span_set
+        return d
 
 
 @dataclass

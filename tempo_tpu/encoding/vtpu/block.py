@@ -327,6 +327,31 @@ class VtpuBackendBlock:
                 break
         return out
 
+    def iter_eval_views(self, pipeline, start_s: int = 0, end_s: int = 0):
+        """Projection-limited column views for the vectorized TraceQL
+        path (traceql/vector.py): per time-pruned row group, decode only
+        the span columns the pipeline names (+ the attr table when a
+        non-dedicated attribute appears) — the columnar analog of the
+        reference's per-predicate parquet column iterators
+        (vparquet/block_traceql.go:279)."""
+        from tempo_tpu.model.columnar import _empty_cols
+        from tempo_tpu.traceql import vector
+
+        span_cols, needs_attrs = vector.needed_columns(pipeline)
+        d = self.dictionary()
+        for rg in self.index().row_groups:
+            if start_s and rg.end_s < start_s:
+                continue
+            if end_s and rg.start_s > end_s:
+                continue
+            cols = self.read_columns(rg, span_cols)
+            attrs = (
+                self.read_columns(rg, list(ATTR_COLUMNS))
+                if needs_attrs
+                else _empty_cols(ATTR_COLUMNS)
+            )
+            yield vector.ColumnView(cols, attrs, rg.n_spans), d
+
     def collect_spans_for_ids(self, hex_ids: set) -> list:
         """All spans of the given trace IDs present in this block.
 
@@ -352,25 +377,41 @@ class VtpuBackendBlock:
         return out
 
 
+_STR_OPS = ("=", "=~", "!=", "!~")
+
+
 def _lower_condition(cond, d):
     """Condition -> callable(block, rg) -> span mask, or None
-    (unsupported), or "impossible" (can never match this block)."""
+    (unsupported), or "impossible" (can never match this block).
+
+    Negated ops (!=, !~) lower to inverted code-set scans: a superset of
+    the exact result (spans lacking the column/attr may slip through;
+    the engine re-evaluates exactly). Reference: the reference pushes
+    OpNotEqual/OpNotRegex into parquet predicates the same way
+    (vparquet/block_traceql.go createPredicate)."""
     op, val = cond.op, cond.value
 
-    def col_mask(col_name, codes):
+    def col_mask(col_name, codes, invert=False):
         def run(blk, rg):
             c = blk.read_columns(rg, [col_name])[col_name]
-            return np.isin(c, codes)
+            if codes is None:  # negated op with nothing to exclude
+                return np.ones(rg.n_spans, bool)
+            return np.isin(c, codes, invert=invert)
 
         return run
 
-    if cond.scope == "intrinsic":
-        if cond.name == "name" and op in ("=", "=~"):
-            codes = _string_codes(d, op, val)
+    def str_col(col_name):
+        codes = _string_codes(d, "=" if op in ("=", "!=") else "=~", val)
+        if op in ("=", "=~"):
             if codes is None:
                 return "impossible"
-            return col_mask("name", codes)
-        if cond.name == "duration" and op in (">", ">=", "<", "<=", "="):
+            return col_mask(col_name, codes)
+        return col_mask(col_name, codes, invert=True)
+
+    if cond.scope == "intrinsic":
+        if cond.name == "name" and op in _STR_OPS:
+            return str_col("name")
+        if cond.name == "duration" and op in (">", ">=", "<", "<=", "=", "!="):
             def run(blk, rg):
                 dur = blk.read_columns(rg, ["duration_nano"])["duration_nano"]
                 return {
@@ -379,40 +420,33 @@ def _lower_condition(cond, d):
                     "<": dur < val,
                     "<=": dur <= val,
                     "=": dur == val,
+                    "!=": dur != val,
                 }[op]
 
             return run
-        if cond.name in ("status", "kind") and op == "=":
+        if cond.name in ("status", "kind") and op in ("=", "!="):
             col = "status_code" if cond.name == "status" else "kind"
 
             def run(blk, rg):
                 c = blk.read_columns(rg, [col])[col]
-                return c == val
+                return (c == val) if op == "=" else (c != val)
 
             return run
         return None
 
     if cond.scope in ("any", "span", "resource"):
-        if cond.name == "service.name" and op in ("=", "=~"):
-            codes = _string_codes(d, op, val)
-            if codes is None:
-                return "impossible"
-            return col_mask("service", codes)
-        if cond.name == "http.method" and op in ("=", "=~"):
-            codes = _string_codes(d, op, val)
-            if codes is None:
-                return "impossible"
-            return col_mask("http_method", codes)
-        if cond.name == "http.url" and op in ("=", "=~"):
-            codes = _string_codes(d, op, val)
-            if codes is None:
-                return "impossible"
-            return col_mask("http_url", codes)
-        if cond.name == "http.status_code" and op in ("=", ">", ">=", "<", "<="):
+        if cond.name == "service.name" and op in _STR_OPS:
+            return str_col("service")
+        if cond.name == "http.method" and op in _STR_OPS:
+            return str_col("http_method")
+        if cond.name == "http.url" and op in _STR_OPS:
+            return str_col("http_url")
+        if cond.name == "http.status_code" and op in ("=", "!=", ">", ">=", "<", "<="):
             def run(blk, rg):
                 c = blk.read_columns(rg, ["http_status"])["http_status"]
                 return {
                     "=": c == val,
+                    "!=": c != val,
                     ">": c > val,
                     ">=": c >= val,
                     "<": c < val,
@@ -431,21 +465,26 @@ def _lower_attr_condition(cond, d):
     op, val = cond.op, cond.value
     kc = d.get(cond.name)
     if kc is None:
+        # negated ops are trivially satisfied by every span carrying the
+        # attr — but the key itself is absent from this block, so nothing
+        # can match either way ("span HAS attr and value differs")
         return "impossible"
 
+    invert = False
     if isinstance(val, str):
-        if op not in ("=", "=~"):
+        if op not in ("=", "=~", "!=", "!~"):
             return None
-        codes = _string_codes(d, op, val)
-        if codes is None:
+        codes = _string_codes(d, "=" if op in ("=", "!=") else "=~", val)
+        invert = op in ("!=", "!~")
+        if codes is None and not invert:
             return "impossible"
         want_vt = VT_STR
     elif isinstance(val, bool):
-        if op != "=":
+        if op not in ("=", "!="):
             return None
         codes, want_vt = None, VT_BOOL
     elif isinstance(val, (int, float)):
-        if op not in ("=", ">", ">=", "<", "<="):
+        if op not in ("=", "!=", ">", ">=", "<", "<="):
             return None
         codes, want_vt = None, None  # numeric: INT or FLOAT
     else:
@@ -459,13 +498,20 @@ def _lower_attr_condition(cond, d):
         elif cond.scope == "resource":
             rows &= a["attr_scope"] == SCOPE_RESOURCE
         if want_vt == VT_STR:
-            rows &= (a["attr_vtype"] == VT_STR) & np.isin(a["attr_str"], codes)
+            rows &= a["attr_vtype"] == VT_STR
+            if codes is None:  # negated, value not in dictionary: all differ
+                pass
+            else:
+                rows &= np.isin(a["attr_str"], codes, invert=invert)
         elif want_vt == VT_BOOL:
-            rows &= (a["attr_vtype"] == VT_BOOL) & ((a["attr_num"] != 0) == val)
+            rows &= (a["attr_vtype"] == VT_BOOL) & (
+                ((a["attr_num"] != 0) == val) if op == "=" else ((a["attr_num"] != 0) != val)
+            )
         else:
             num = a["attr_num"]
             rows &= np.isin(a["attr_vtype"], [VT_INT, VT_FLOAT]) & {
                 "=": num == val,
+                "!=": num != val,
                 ">": num > val,
                 ">=": num >= val,
                 "<": num < val,

@@ -114,7 +114,11 @@ class VtpuCompactor:
 
         while True:
             for i, s in enumerate(streams):
-                if (buffers[i] is None or buffers[i].num_spans == 0) and not s.exhausted():
+                # loop (not if): an empty row group in a corrupted or
+                # foreign block must not stall the refill — dropping out
+                # with an empty buffer while the stream still has rows
+                # would silently truncate the merge
+                while (buffers[i] is None or buffers[i].num_spans == 0) and not s.exhausted():
                     buffers[i] = s.next_batch()
             live = [i for i in range(len(streams)) if buffers[i] is not None and buffers[i].num_spans > 0]
             if not live:
@@ -546,14 +550,18 @@ def _attr_fingerprint(batch: SpanBatch) -> np.ndarray:
     path, since a false "equal" only means keep-one of two copies.
     """
     a = batch.attrs
-    h = (
-        a["attr_scope"].astype(np.uint64)
-        ^ (a["attr_key"].astype(np.uint64) << np.uint64(8))
-        ^ (a["attr_vtype"].astype(np.uint64) << np.uint64(40))
-        ^ (a["attr_str"].astype(np.uint64) << np.uint64(16))
-        ^ a["attr_num"].view(np.uint64)
-    )
+    # each field is spread by its own odd multiplier BEFORE combining, so
+    # structurally related sets (key=256/str=0 vs key=0/str=1 under the
+    # old shifted packing) cannot cancel; the splitmix finalizer then
+    # mixes the combined word
     with np.errstate(over="ignore"):
+        h = (
+            a["attr_scope"].astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+            ^ a["attr_key"].astype(np.uint64) * np.uint64(0xC2B2AE3D27D4EB4F)
+            ^ a["attr_vtype"].astype(np.uint64) * np.uint64(0x165667B19E3779F9)
+            ^ a["attr_str"].astype(np.uint64) * np.uint64(0x27D4EB2F165667C5)
+            ^ a["attr_num"].view(np.uint64) * np.uint64(0x2545F4914F6CDD1D)
+        )
         h = (h ^ (h >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
         h = (h ^ (h >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
         h = h ^ (h >> np.uint64(31))

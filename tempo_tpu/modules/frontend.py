@@ -189,6 +189,7 @@ class Frontend:
                         root_trace_name=t.get("rootTraceName", ""),
                         start_time_unix_nano=int(t.get("startTimeUnixNano", "0")),
                         duration_ms=t.get("durationMs", 0),
+                        span_set=t.get("spanSet"),
                     )
                 )
         return out

@@ -260,7 +260,7 @@ class SpansetFilter:
 
 @dataclass
 class SpansetOp:
-    op: str  # && | "||" | ">" | ">>"
+    op: str  # && | "||" | ">" | ">>" | "~" (sibling)
     lhs: object
     rhs: object
 
@@ -317,13 +317,54 @@ class Coalesce:
 
 
 @dataclass
-class Pipeline:
-    stages: list  # spanset expr first, then AggregateFilter/Coalesce
+class GroupBy:
+    """`| by(expr)` — partition each spanset by the per-span value of
+    expr (reference: groupOperation, pkg/traceql/expr.y BY)."""
+
+    expr: Expr
 
     def conditions(self) -> FetchSpec:
-        spec = self.stages[0].conditions()
-        if len(self.stages) > 1:
-            # later stages can only drop spansets; span-level pushdown from
-            # the first stage remains valid
-            pass
-        return spec
+        return FetchSpec(conditions=[], all_conditions=False)
+
+
+@dataclass
+class Select:
+    """`| select(expr, ...)` — attach the given fields to returned spans
+    (reference: the select() pipeline stage; fetch-only conditions with
+    op None ask storage to retrieve the columns without filtering,
+    pkg/traceql/storage.go condition contract)."""
+
+    exprs: list  # Attribute / Intrinsic nodes
+
+    def conditions(self) -> FetchSpec:
+        conds = []
+        for e in self.exprs:
+            if isinstance(e, Attribute) and e.scope != "parent":
+                conds.append(Condition(e.scope, e.name, None))
+            elif isinstance(e, Intrinsic):
+                conds.append(Condition("intrinsic", e.name, None))
+        return FetchSpec(conditions=conds, all_conditions=False)
+
+
+@dataclass
+class Pipeline:
+    stages: list  # spanset expr first; then filter/by/select/agg/coalesce
+
+    def conditions(self) -> FetchSpec:
+        """Merged pushdown: a span surviving the pipeline must pass every
+        SpansetFilter stage, so their specs AND-compose; other stages
+        (by/select/coalesce/aggregates) only regroup or drop spansets and
+        contribute nothing span-level. Select's fetch-only conditions are
+        omitted — this storage always materializes full rows for
+        candidate traces."""
+        specs = [
+            s.conditions()
+            for s in self.stages
+            if isinstance(s, (SpansetFilter, SpansetOp))
+        ]
+        if not specs:
+            return FetchSpec(conditions=[], all_conditions=False)
+        return FetchSpec(
+            conditions=[c for sp in specs for c in sp.conditions],
+            all_conditions=all(sp.all_conditions for sp in specs),
+        )

@@ -55,6 +55,11 @@ class EvalContext:
 
 
 def eval_spanset_expr(node, spans, ctx):
+    if isinstance(node, A.Pipeline):
+        # wrapped pipeline as spanset operand: evaluate it over the same
+        # input spans; its matched spans are the operand's spanset
+        matched, _sel = run_stages(node, spans, ctx)
+        return matched
     if isinstance(node, A.SpansetFilter):
         return node.matches(spans, ctx)
     if isinstance(node, A.SpansetOp):
@@ -70,6 +75,17 @@ def eval_spanset_expr(node, spans, ctx):
         if node.op == ">>":
             a_ids = {s.span_id for s in a}
             return [s for s in b if any(p.span_id in a_ids for p in ctx.ancestors(s))]
+        if node.op == "~":
+            # sibling: b-spans sharing a parent with a DIFFERENT a-span
+            # (reference: OpSpansetSibling, pkg/traceql/enum_operators.go)
+            by_parent = {}
+            for s in a:
+                by_parent.setdefault(s.parent_span_id, set()).add(s.span_id)
+            return [
+                s
+                for s in b
+                if by_parent.get(s.parent_span_id, set()) - {s.span_id}
+            ]
         raise A.TypeError_(f"unknown spanset op {node.op}")
     raise A.TypeError_(f"unexpected spanset node {node}")
 
@@ -92,8 +108,26 @@ class SpansetResult:
     start_time_unix_nano: int = 0
     duration_ms: int = 0
     spans: list = field(default_factory=list)  # matched Span objects
+    span_attrs: dict = field(default_factory=dict)  # span_id -> select()ed fields
+    # real matched count when spans is truncated (vector path caps the
+    # retained spans per trace); -1 = len(spans)
+    matched_override: int = -1
 
     def to_dict(self):
+        def one(s):
+            d = {
+                "spanID": s.span_id.hex(),
+                "name": s.name,
+                "startTimeUnixNano": str(s.start_unix_nano),
+                "durationNanos": str(s.duration_nano),
+            }
+            sel = self.span_attrs.get(s.span_id)
+            if sel:
+                d["attributes"] = [
+                    {"key": k, "value": _attr_value(v)} for k, v in sel.items()
+                ]
+            return d
+
         return {
             "traceID": self.trace_id_hex,
             "rootServiceName": self.root_service_name,
@@ -101,18 +135,63 @@ class SpansetResult:
             "startTimeUnixNano": str(self.start_time_unix_nano),
             "durationMs": self.duration_ms,
             "spanSet": {
-                "matched": len(self.spans),
-                "spans": [
-                    {
-                        "spanID": s.span_id.hex(),
-                        "name": s.name,
-                        "startTimeUnixNano": str(s.start_unix_nano),
-                        "durationNanos": str(s.duration_nano),
-                    }
-                    for s in self.spans[:20]
-                ],
+                "matched": self.matched_override if self.matched_override >= 0 else len(self.spans),
+                "spans": [one(s) for s in self.spans[:20]],
             },
         }
+
+
+def _attr_value(v):
+    """OTLP-style typed value for the search response JSON."""
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+def run_stages(pipeline, spans, ctx):
+    """Run the pipeline's stages for one trace.
+
+    Returns (matched spans, select exprs). The unit of flow between
+    stages is a LIST of spansets (groups) per trace — by() fans a
+    spanset out into per-value groups, aggregate filters drop groups,
+    coalesce merges them back, and filter stages re-filter each group's
+    spans (reference: pipeline evaluation over []Spanset,
+    pkg/traceql/ast_execute.go + groupOperation/coalesceOperation in
+    expr.y)."""
+    groups = [eval_spanset_expr(pipeline.stages[0], spans, ctx)]
+    select_exprs = []
+    for stage in pipeline.stages[1:]:
+        groups = [g for g in groups if g]
+        if not groups:
+            break
+        if isinstance(stage, (A.SpansetFilter, A.SpansetOp, A.Pipeline)):
+            groups = [eval_spanset_expr(stage, g, ctx) for g in groups]
+        elif isinstance(stage, A.GroupBy):
+            regrouped = {}
+            for g in groups:
+                for s in g:
+                    key = stage.expr.eval(s, ctx)
+                    regrouped.setdefault(key, []).append(s)
+            groups = list(regrouped.values())
+        elif isinstance(stage, A.AggregateFilter):
+            groups = [g for g in groups if stage.test(g, ctx)]
+        elif isinstance(stage, A.Coalesce):
+            merged = []
+            for g in groups:
+                merged = _union(merged, g)
+            groups = [merged]
+        elif isinstance(stage, A.Select):
+            select_exprs.extend(stage.exprs)
+        else:
+            raise A.TypeError_(f"unknown pipeline stage {stage}")
+    matched = []
+    for g in groups:
+        matched = _union(matched, g)
+    return matched, select_exprs
 
 
 class Engine:
@@ -136,30 +215,32 @@ class Engine:
                     continue
                 if end_s and t_start > end_s * 10**9:
                     continue
-            matched = eval_spanset_expr(pipeline.stages[0], spans, ctx)
-            ok = bool(matched)
-            for stage in pipeline.stages[1:]:
-                if not ok:
-                    break
-                if isinstance(stage, A.AggregateFilter):
-                    ok = stage.test(matched, ctx)
-                elif isinstance(stage, A.Coalesce):
-                    pass  # spansets are already per-trace merged here
-            if not ok:
+            matched, select_exprs = run_stages(pipeline, spans, ctx)
+            if not matched:
                 continue
-            results.append(_to_result(trace, matched, ctx))
+            results.append(_to_result(trace, matched, ctx, select_exprs))
             if limit and len(results) >= limit:
                 break
         results.sort(key=lambda r: -r.start_time_unix_nano)
         return results
 
 
-def _to_result(trace, matched, ctx) -> SpansetResult:
+def _to_result(trace, matched, ctx, select_exprs=()) -> SpansetResult:
     spans = ctx.all_spans()
     start = min(s.start_unix_nano for s in spans)
     end = max(s.end_unix_nano for s in spans)
     roots = [s for s in spans if s.parent_span_id == b"\x00" * 8]
     root = roots[0] if roots else spans[0]
+    attrs = {}
+    if select_exprs:
+        for s in matched:
+            vals = {}
+            for e in select_exprs:
+                v = e.eval(s, ctx)
+                if v is not None and not isinstance(v, (dict, list)):
+                    vals[_select_label(e)] = v
+            if vals:
+                attrs[s.span_id] = vals
     return SpansetResult(
         trace_id_hex=trace.trace_id.hex(),
         root_service_name=ctx.resource_of(root).get("service.name", ""),
@@ -167,7 +248,14 @@ def _to_result(trace, matched, ctx) -> SpansetResult:
         start_time_unix_nano=start,
         duration_ms=(end - start) // 10**6,
         spans=sorted(matched, key=lambda s: s.start_unix_nano),
+        span_attrs=attrs,
     )
+
+
+def _select_label(e) -> str:
+    if isinstance(e, A.Attribute):
+        return f"{e.scope}.{e.name}" if e.scope != "any" else f".{e.name}"
+    return e.name
 
 
 def execute(query: str, fetch, **kw) -> list[SpansetResult]:

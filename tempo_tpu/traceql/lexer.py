@@ -9,7 +9,7 @@ KEYWORDS = {
     "true", "false", "nil",
     "ok", "error", "unset",
     "client", "server", "internal", "producer", "consumer", "unspecified",
-    "count", "avg", "min", "max", "sum", "coalesce",
+    "count", "avg", "min", "max", "sum", "coalesce", "by", "select",
     "duration", "name", "status", "kind", "childCount", "parent",
     "resource", "span",
 }
@@ -21,7 +21,7 @@ _IDENT_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_\-./]*")
 _ATTR_RE = re.compile(r"[a-zA-Z0-9_\-./]+")
 
 _TWO_CHAR = ("&&", "||", ">>", ">=", "<=", "!=", "=~", "!~")
-_ONE_CHAR = "{}()|=<>!+-*/%^,."
+_ONE_CHAR = "{}()|=<>!+-*/%^,.~"
 
 DURATION_NS = {
     "ns": 1,

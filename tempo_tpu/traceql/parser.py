@@ -54,8 +54,14 @@ class Parser:
 
     # -- entry ----------------------------------------------------------
     def parse(self) -> A.Pipeline:
-        expr = self.parse_spanset_expr()
-        stages = [expr]
+        t = self.peek()
+        if t.kind == "keyword" and (t.text in AGG_NAMES or t.text == "by"):
+            # a pipeline may start with a scalar filter or by() — the
+            # implicit input is the match-all spanset (reference:
+            # spansetPipeline: scalarFilter | groupOperation, expr.y)
+            stages = [A.SpansetFilter(None), self.parse_stage()]
+        else:
+            stages = [self.parse_spanset_expr()]
         while self.accept("op", "|"):
             stages.append(self.parse_stage())
         self.expect("eof")
@@ -66,7 +72,7 @@ class Parser:
         lhs = self.parse_spanset_primary()
         while True:
             t = self.peek()
-            if t.kind == "op" and t.text in ("&&", "||", ">", ">>"):
+            if t.kind == "op" and t.text in ("&&", "||", ">", ">>", "~"):
                 self.next()
                 rhs = self.parse_spanset_primary()
                 lhs = A.SpansetOp(t.text, lhs, rhs)
@@ -76,6 +82,13 @@ class Parser:
     def parse_spanset_primary(self):
         if self.accept("op", "("):
             e = self.parse_spanset_expr()
+            if self.peek().kind == "op" and self.peek().text == "|":
+                # wrapped pipeline as a spanset operand (reference:
+                # wrappedSpansetPipeline, pkg/traceql/expr.y)
+                stages = [e]
+                while self.accept("op", "|"):
+                    stages.append(self.parse_stage())
+                e = A.Pipeline(stages)
             self.expect("op", ")")
             return e
         self.expect("op", "{")
@@ -87,11 +100,33 @@ class Parser:
 
     def parse_stage(self):
         t = self.peek()
+        if t.kind == "op" and t.text in ("{", "("):
+            # `| { ... }` (or a parenthesized spanset expr): re-filter
+            # the spans of each spanset (reference: spansetPipeline PIPE
+            # spansetExpression, pkg/traceql/expr.y)
+            return self.parse_spanset_expr()
         if t.kind == "keyword" and t.text == "coalesce":
             self.next()
             self.expect("op", "(")
             self.expect("op", ")")
             return A.Coalesce()
+        if t.kind == "keyword" and t.text == "by":
+            self.next()
+            self.expect("op", "(")
+            expr = self.parse_field_expr()
+            self.expect("op", ")")
+            return A.GroupBy(expr)
+        if t.kind == "keyword" and t.text == "select":
+            self.next()
+            self.expect("op", "(")
+            exprs = [self.parse_field_expr()]
+            while self.accept("op", ","):
+                exprs.append(self.parse_field_expr())
+            self.expect("op", ")")
+            for e in exprs:
+                if not isinstance(e, (A.Attribute, A.Intrinsic)):
+                    raise ParseError("select() takes attribute or intrinsic fields")
+            return A.Select(exprs)
         if t.kind == "keyword" and t.text in AGG_NAMES:
             self.next()
             self.expect("op", "(")
@@ -192,7 +227,7 @@ class Parser:
             # scoped attributes lex as one ident because '.' is an ident
             # char: span.level, resource.service.name, parent.name
             for scope in ("span", "resource", "parent"):
-                if t.text.startswith(scope + "."):
+                if t.text.startswith(scope + ".") and len(t.text) > len(scope) + 1:
                     self.next()
                     return A.Attribute(scope, t.text[len(scope) + 1 :])
         raise ParseError(f"unexpected token {t.text!r} at {t.pos}")

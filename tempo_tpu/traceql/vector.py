@@ -1,0 +1,644 @@
+"""Vectorized TraceQL evaluation over columnar span batches.
+
+The object engine (engine.py) materializes python Span dicts per trace
+and walks them per span — fine for ingester live traces, but the
+hottest read loop of the reference runs as compiled column scans
+(vparquet/block_traceql.go:279-617 iterator trees). This module is the
+columnar equivalent: the whole pipeline evaluates as numpy array ops
+over a row group's SpanBatch, and per-trace aggregates are computed as
+segment reductions.
+
+Cross-block correctness: a trace's spans may straddle blocks, so block
+evaluation returns per-trace PARTIALS — matched span masks are span-
+local (safe per block), while aggregate inputs (count/sum/min/max) are
+associative and merge across blocks before the final aggregate filter
+(db.traceql_search drives the merge). Queries using structure that is
+not span-local (parent.*, childCount, parent-nil, structural spanset
+ops, by(), select()) raise Unsupported and fall back to the object
+engine.
+
+Type model: every field expression evaluates to (kind, values, defined)
+with kind in {num, bool, str}; strings are block-dictionary codes, so
+equality is code compare and regex resolves to a code set once per
+block (the reference's dictionary-pruning trick,
+pkg/parquetquery/predicates.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from tempo_tpu.model.columnar import (
+    SCOPE_RESOURCE,
+    SCOPE_SPAN,
+    VT_BOOL,
+    VT_FLOAT,
+    VT_INT,
+    VT_STR,
+)
+from tempo_tpu.traceql import ast_nodes as A
+
+MAX_SPANS_PER_RESULT = 20  # spans shown per trace in the HTTP response
+# (all matched spans are retained in partials — the object engine does the
+# same, and downstream combining needs them)
+
+
+class Unsupported(Exception):
+    """Query shape the vector path does not cover; use the object engine."""
+
+
+class ColumnView:
+    """Duck-typed, projection-limited stand-in for SpanBatch: only the
+    columns a query touches are fetched/decoded (reference analog: the
+    iterator tree only reads the parquet columns its predicates name)."""
+
+    def __init__(self, cols: dict, attrs: dict, n: int):
+        self.cols = cols
+        self.attrs = attrs
+        self._n = n
+        self._tb = None
+
+    @property
+    def num_spans(self) -> int:
+        return self._n
+
+    def trace_boundaries(self):
+        if self._tb is None:
+            tid = self.cols["trace_id"]
+            new = np.ones(self._n, dtype=bool)
+            new[1:] = (tid[1:] != tid[:-1]).any(axis=1)
+            seg = np.cumsum(new) - 1
+            self._tb = (np.flatnonzero(new), seg)
+        return self._tb
+
+
+def needed_columns(pipeline: A.Pipeline):
+    """(span column names, needs_attr_table) for a supported pipeline."""
+    span_cols = set(_BASE_COLS)
+    needs_attrs = [False]
+
+    def walk(e):
+        if isinstance(e, A.Attribute):
+            served = e.name in _DEDICATED_SCOPES and e.scope in _DEDICATED_SCOPES[e.name]
+            if served:
+                span_cols.add(_DEDICATED.get(e.name, "http_status"))
+            if not served or e.scope == "any":
+                # attr-table lookup: unserved scopes always; "any" also
+                # probes the table for the scope the dedicated column
+                # does not cover (an explicit attr may shadow it)
+                needs_attrs[0] = True
+        elif isinstance(e, A.Intrinsic):
+            if e.name == "status":
+                span_cols.add("status_code")
+            elif e.name == "kind":
+                span_cols.add("kind")
+        elif isinstance(e, A.Unary):
+            walk(e.expr)
+        elif isinstance(e, A.Binary):
+            walk(e.lhs)
+            walk(e.rhs)
+
+    for stage in pipeline.stages:
+        if isinstance(stage, A.SpansetFilter) and stage.expr is not None:
+            walk(stage.expr)
+        elif isinstance(stage, A.AggregateFilter) and stage.field_expr is not None:
+            walk(stage.field_expr)
+    return sorted(span_cols), needs_attrs[0]
+
+
+# span columns every evaluation needs
+_BASE_COLS = ["trace_id", "span_id", "parent_span_id", "start_unix_nano",
+              "duration_nano", "name", "service"]
+
+_DEDICATED = {
+    "service.name": "service",
+    "http.method": "http_method",
+    "http.url": "http_url",
+}
+
+# scopes each dedicated column answers for (mirrors where the object
+# model places the value: model/trace.py WELL_KNOWN_SPAN_ATTRS are span
+# attrs; service.name lives on the resource)
+_DEDICATED_SCOPES = {
+    "service.name": ("any", "resource"),
+    "http.method": ("any", "span"),
+    "http.url": ("any", "span"),
+    "http.status_code": ("any", "span"),
+}
+
+
+def supports(pipeline: A.Pipeline) -> bool:
+    try:
+        _validate(pipeline)
+        return True
+    except Unsupported:
+        return False
+
+
+def _validate(pipeline: A.Pipeline):
+    if not isinstance(pipeline.stages[0], A.SpansetFilter):
+        raise Unsupported("structural spanset ops")
+    seen_agg = False
+    for stage in pipeline.stages:
+        if isinstance(stage, A.SpansetFilter):
+            if seen_agg:
+                # the flat-mask model folds all filters together before
+                # aggregates resolve (at cross-block finalize), so a
+                # filter AFTER an aggregate would change what the
+                # aggregate observes — stage order matters there
+                raise Unsupported("filter stage after aggregate filter")
+            if stage.expr is not None:
+                _validate_expr(stage.expr)
+        elif isinstance(stage, A.AggregateFilter):
+            seen_agg = True
+            if stage.field_expr is not None:
+                _validate_expr(stage.field_expr)
+        elif isinstance(stage, A.Coalesce):
+            pass
+        else:
+            raise Unsupported(f"stage {type(stage).__name__}")
+
+
+def _validate_expr(e: A.Expr):
+    if isinstance(e, A.Literal):
+        return
+    if isinstance(e, A.Attribute):
+        if e.scope == "parent":
+            raise Unsupported("parent attributes")
+        return
+    if isinstance(e, A.Intrinsic):
+        if e.name in ("childCount", "parent"):
+            raise Unsupported(e.name)
+        return
+    if isinstance(e, A.Unary):
+        return _validate_expr(e.expr)
+    if isinstance(e, A.Binary):
+        if isinstance(e.lhs, A.Intrinsic) and e.lhs.name == "parent":
+            if isinstance(e.rhs, A.Literal) and e.rhs.kind == "nil":
+                return  # parent = nil is span-local (root test)
+        if isinstance(e.rhs, A.Intrinsic) and e.rhs.name == "parent":
+            if isinstance(e.lhs, A.Literal) and e.lhs.kind == "nil":
+                return
+        _validate_expr(e.lhs)
+        _validate_expr(e.rhs)
+        return
+    raise Unsupported(type(e).__name__)
+
+
+# ---------------------------------------------------------------------------
+# expression evaluation -> (kind, values, defined)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Ctx:
+    batch: object  # SpanBatch
+    d: object  # Dictionary
+    n: int
+    _attr_cache: dict = field(default_factory=dict)
+
+    def attr_values(self, scope: str, name: str):
+        """(kind, values, defined) for an attribute across all spans."""
+        key = (scope, name)
+        if key in self._attr_cache:
+            return self._attr_cache[key]
+        out = self._compute_attr(scope, name)
+        self._attr_cache[key] = out
+        return out
+
+    def _compute_attr(self, scope, name):
+        # dedicated columns serve only the scope the object model stores
+        # them under (model/trace.py: http.* are span attrs, service.name
+        # is resource-level); the other scope falls through to the attr
+        # table so results match the object engine exactly
+        col = _DEDICATED.get(name)
+        if col is not None and scope in _DEDICATED_SCOPES[name]:
+            codes = self.batch.cols[col].astype(np.uint32)
+            return ("str", codes, codes != 0)
+        if name == "http.status_code" and scope in ("any", "span"):
+            v = self.batch.cols["http_status"].astype(np.float64)
+            return ("num", v, v != 0)
+        kc = self.d.get(name)
+        if kc is None:
+            return (None, None, np.zeros(self.n, bool))
+        a = self.batch.attrs
+        rows = a["attr_key"] == np.uint32(kc)
+        if scope == "span":
+            rows &= a["attr_scope"] == SCOPE_SPAN
+        elif scope == "resource":
+            rows &= a["attr_scope"] == SCOPE_RESOURCE
+        idx = np.flatnonzero(rows)
+        if len(idx) == 0:
+            return (None, None, np.zeros(self.n, bool))
+        vts = a["attr_vtype"][idx]
+        vt = vts[0]
+        if not (vts == vt).all():
+            raise Unsupported(f"attr {name} has mixed value types in block")
+        owners = a["attr_span"][idx]
+        defined = np.zeros(self.n, bool)
+        defined[owners] = True
+        if vt == VT_STR:
+            vals = np.zeros(self.n, np.uint32)
+            vals[owners] = a["attr_str"][idx]
+            return ("str", vals, defined)
+        if vt == VT_BOOL:
+            vals = np.zeros(self.n, bool)
+            vals[owners] = a["attr_num"][idx] != 0
+            return ("bool", vals, defined)
+        vals = np.zeros(self.n, np.float64)
+        vals[owners] = a["attr_num"][idx]
+        return ("num", vals, defined)
+
+
+def _lit(e: A.Literal, ctx: _Ctx):
+    n = ctx.n
+    if e.kind == "string":
+        code = ctx.d.get(e.value)
+        # absent string: no code can equal it; represent as sentinel
+        val = np.uint32(code) if code is not None else np.uint32(0xFFFFFFFF)
+        return ("str", np.full(n, val, np.uint32), np.ones(n, bool))
+    if e.kind == "bool":
+        return ("bool", np.full(n, e.value, bool), np.ones(n, bool))
+    if e.kind == "nil":
+        return ("nil", None, np.zeros(n, bool))
+    # int/float/duration/status/kind all compare numerically
+    return ("num", np.full(n, float(e.value), np.float64), np.ones(n, bool))
+
+
+def _eval(e: A.Expr, ctx: _Ctx):
+    n = ctx.n
+    if isinstance(e, A.Literal):
+        return _lit(e, ctx)
+    if isinstance(e, A.Attribute):
+        if e.scope == "any":
+            # span-scoped value wins, resource fills the gaps — mirror
+            # Attribute.eval's precedence
+            ks, vs, ds = ctx.attr_values("span", e.name)
+            kr, vr, dr = ctx.attr_values("resource", e.name)
+            if ks is None and kr is None:
+                return (None, None, np.zeros(n, bool))
+            if ks is None:
+                return (kr, vr, dr)
+            if kr is None:
+                return (ks, vs, ds)
+            if ks != kr:
+                raise Unsupported(f"attr {e.name} span/resource type mismatch")
+            return (ks, np.where(ds, vs, vr), ds | dr)
+        return ctx.attr_values(e.scope, e.name)
+    if isinstance(e, A.Intrinsic):
+        b = ctx.batch
+        if e.name == "duration":
+            return ("num", b.cols["duration_nano"].astype(np.float64), np.ones(n, bool))
+        if e.name == "name":
+            return ("str", b.cols["name"].astype(np.uint32), np.ones(n, bool))
+        if e.name == "status":
+            return ("num", b.cols["status_code"].astype(np.float64), np.ones(n, bool))
+        if e.name == "kind":
+            return ("num", b.cols["kind"].astype(np.float64), np.ones(n, bool))
+        raise Unsupported(e.name)
+    if isinstance(e, A.Unary):
+        k, v, d = _eval(e.expr, ctx)
+        if e.op == "-":
+            if k != "num":
+                return ("num", np.zeros(n, np.float64), np.zeros(n, bool))
+            return ("num", -v, d)
+        bk = _as_bool(k, v, d, n)
+        return ("bool", ~bk & d, d)
+    if isinstance(e, A.Binary):
+        return _eval_binary(e, ctx)
+    raise Unsupported(type(e).__name__)
+
+
+def _as_bool(kind, vals, defined, n):
+    if kind == "bool":
+        return vals & defined
+    if kind is None or vals is None:
+        return np.zeros(n, bool)
+    if kind == "num":
+        return (vals != 0) & defined
+    return defined  # strings: defined = truthy (matches object engine bool())
+
+
+def _parent_nil_mask(e: A.Binary, ctx: _Ctx):
+    """`parent = nil` / `parent != nil` -> root-span test."""
+    sides = (e.lhs, e.rhs)
+    has_parent_intr = any(isinstance(s, A.Intrinsic) and s.name == "parent" for s in sides)
+    has_nil = any(isinstance(s, A.Literal) and s.kind == "nil" for s in sides)
+    if not (has_parent_intr and has_nil and e.op in ("=", "!=")):
+        return None
+    is_root = (ctx.batch.cols["parent_span_id"] == 0).all(axis=1)
+    return is_root if e.op == "=" else ~is_root
+
+
+def _eval_binary(e: A.Binary, ctx: _Ctx):
+    import re
+
+    n = ctx.n
+    op = e.op
+    pm = _parent_nil_mask(e, ctx)
+    if pm is not None:
+        return ("bool", pm, np.ones(n, bool))
+    if op in ("&&", "||"):
+        lk, lv, ld = _eval(e.lhs, ctx)
+        rk, rv, rd = _eval(e.rhs, ctx)
+        lb = _as_bool(lk, lv, ld, n)
+        rb = _as_bool(rk, rv, rd, n)
+        return ("bool", (lb & rb) if op == "&&" else (lb | rb), np.ones(n, bool))
+
+    # nil equality on attributes: defined-ness test
+    for fld, lit in ((e.lhs, e.rhs), (e.rhs, e.lhs)):
+        if isinstance(lit, A.Literal) and lit.kind == "nil" and op in ("=", "!="):
+            _k, _v, d = _eval(fld, ctx)
+            return ("bool", ~d if op == "=" else d, np.ones(n, bool))
+
+    lk, lv, ld = _eval(e.lhs, ctx)
+    rk, rv, rd = _eval(e.rhs, ctx)
+    both = ld & rd
+
+    if op in ("=~", "!~"):
+        if lk != "str":
+            return ("bool", np.zeros(n, bool), np.ones(n, bool))
+        if not (isinstance(e.rhs, A.Literal) and e.rhs.kind == "string"):
+            raise Unsupported("dynamic regex")
+        codes = _regex_codes(ctx.d, e.rhs.value)
+        hit = np.isin(lv, codes) & ld
+        return ("bool", hit if op == "=~" else (~hit & ld), np.ones(n, bool))
+
+    if lk is None or rk is None or lv is None or rv is None:
+        # undefined side: = / != / comparisons are False (object engine
+        # returns False when either side is None)
+        if op in A.ARITH_OPS:
+            return (None, None, np.zeros(n, bool))
+        return ("bool", np.zeros(n, bool), np.ones(n, bool))
+
+    if op in ("=", "!="):
+        if lk == rk:
+            eq = (lv == rv) & both
+        elif {lk, rk} == {"num", "bool"}:
+            eq = (lv.astype(np.float64) == rv.astype(np.float64)) & both
+        else:
+            eq = np.zeros(n, bool)
+        if op == "=":
+            return ("bool", eq, np.ones(n, bool))
+        return ("bool", ~eq & both, np.ones(n, bool))
+
+    if op in (">", ">=", "<", "<="):
+        if lk != "num" or rk != "num":
+            return ("bool", np.zeros(n, bool), np.ones(n, bool))
+        cmp = {">": lv > rv, ">=": lv >= rv, "<": lv < rv, "<=": lv <= rv}[op]
+        return ("bool", cmp & both, np.ones(n, bool))
+
+    if op in A.ARITH_OPS:
+        if lk != "num" or rk != "num":
+            return (None, None, np.zeros(n, bool))
+        with np.errstate(divide="ignore", invalid="ignore", over="ignore"):
+            if op == "+":
+                v = lv + rv
+            elif op == "-":
+                v = lv - rv
+            elif op == "*":
+                v = lv * rv
+            elif op == "/":
+                v = np.where(rv != 0, lv / np.where(rv != 0, rv, 1), 0)
+                both = both & (rv != 0)
+            elif op == "%":
+                v = np.where(rv != 0, np.mod(lv, np.where(rv != 0, rv, 1)), 0)
+                both = both & (rv != 0)
+            else:  # ^
+                v = lv**rv
+        return ("num", v, both)
+
+    raise Unsupported(op)
+
+
+def _regex_codes(d, pattern: str) -> np.ndarray:
+    """Dictionary codes matching a regex, cached per block dictionary —
+    the dictionary is shared by all of a block's row groups, so the
+    Python-level scan runs once per (block, pattern), not per row group."""
+    import re
+
+    cache = getattr(d, "_rx_code_cache", None)
+    if cache is None:
+        cache = {}
+        d._rx_code_cache = cache
+    key = (pattern, len(d.entries))  # length guards append-only growth
+    codes = cache.get(key)
+    if codes is None:
+        rx = re.compile(pattern)
+        codes = np.asarray(
+            [i for i, s in enumerate(d.entries) if rx.search(s)], np.uint32
+        )
+        cache[key] = codes
+    return codes
+
+
+def filter_mask(expr: A.Expr | None, batch, dictionary) -> np.ndarray:
+    """Exact span mask for one spanset filter over a batch."""
+    n = batch.num_spans
+    if expr is None:
+        return np.ones(n, bool)
+    ctx = _Ctx(batch=batch, d=dictionary, n=n)
+    k, v, d = _eval(expr, ctx)
+    # only a boolean True matches (object engine: isinstance(v, bool) and v)
+    if k != "bool":
+        return np.zeros(n, bool)
+    return v & d
+
+
+# ---------------------------------------------------------------------------
+# per-trace partials + cross-block merge
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TracePartial:
+    trace_id: bytes
+    matched: int = 0
+    # aggregate partials per AggregateFilter index: (count, total, mn, mx)
+    aggs: list = field(default_factory=list)
+    # response metadata partials
+    start: int = 0
+    end: int = 0
+    root_service: str = ""
+    root_name: str = ""
+    spans: list = field(default_factory=list)  # (start, span_id_hex, name, dur)
+
+    def merge(self, other: "TracePartial"):
+        self.matched += other.matched
+        for i, (c, t, mn, mx) in enumerate(other.aggs):
+            c0, t0, mn0, mx0 = self.aggs[i]
+            self.aggs[i] = (c0 + c, t0 + t, min(mn0, mn), max(mx0, mx))
+        self.start = min(self.start, other.start)
+        self.end = max(self.end, other.end)
+        if not self.root_service and other.root_service:
+            self.root_service = other.root_service
+            self.root_name = other.root_name
+        self.spans.extend(other.spans)
+
+
+def evaluate_batch(pipeline: A.Pipeline, batch, dictionary) -> dict:
+    """One row-group batch -> {trace_id_bytes: TracePartial}.
+
+    Aggregate filters are NOT applied here — their inputs are collected
+    as associative partials and resolved in finalize() after all blocks
+    merged (a trace may straddle blocks)."""
+    n = batch.num_spans
+    if n == 0:
+        return {}
+    ctx = _Ctx(batch=batch, d=dictionary, n=n)
+
+    mask = filter_mask(pipeline.stages[0].expr, batch, dictionary)
+    agg_stages = []
+    for stage in pipeline.stages[1:]:
+        if isinstance(stage, A.SpansetFilter):
+            if mask.any():
+                mask = mask & filter_mask(stage.expr, batch, dictionary)
+        elif isinstance(stage, A.AggregateFilter):
+            agg_stages.append(stage)
+        # Coalesce: no-op in the flat-mask model
+    if not mask.any():
+        return {}
+
+    firsts, seg = batch.trace_boundaries()
+    n_traces = len(firsts)
+    m_count = np.bincount(seg[mask], minlength=n_traces)
+    hit_traces = np.flatnonzero(m_count > 0)
+
+    # aggregate inputs evaluated over MATCHED spans only
+    agg_parts = []
+    for stage in agg_stages:
+        if stage.agg == "count":
+            agg_parts.append((m_count, np.zeros(n_traces), None, None))
+            continue
+        k, v, d = _eval(stage.field_expr, ctx)
+        if k != "num":
+            v = np.zeros(n, np.float64)
+            d = np.zeros(n, bool)
+        ok = mask & d
+        cnt = np.bincount(seg[ok], minlength=n_traces)
+        tot = np.bincount(seg[ok], weights=v[ok], minlength=n_traces)
+        mn = np.full(n_traces, np.inf)
+        mx = np.full(n_traces, -np.inf)
+        if ok.any():
+            np.minimum.at(mn, seg[ok], v[ok])
+            np.maximum.at(mx, seg[ok], v[ok])
+        agg_parts.append((cnt, tot, mn, mx))
+
+    tid = batch.cols["trace_id"]
+    starts = batch.cols["start_unix_nano"]
+    ends = starts + batch.cols["duration_nano"]
+    is_root = (batch.cols["parent_span_id"] == 0).all(axis=1)
+    sid = batch.cols["span_id"]
+    names = batch.cols["name"]
+    service = batch.cols["service"]
+
+    out = {}
+    for t in hit_traces:
+        lo = int(firsts[t])
+        hi = int(firsts[t + 1]) if t + 1 < n_traces else n
+        rows = np.arange(lo, hi)
+        tid_bytes = np.ascontiguousarray(tid[lo]).astype(">u4").tobytes()
+        roots = rows[is_root[lo:hi]]
+        root = int(roots[0]) if len(roots) else lo
+        m_rows = rows[mask[lo:hi]]
+        p = TracePartial(
+            trace_id=tid_bytes,
+            matched=int(m_count[t]),
+            start=int(starts[rows].min()),
+            end=int(ends[rows].max()),
+            root_service=dictionary[int(service[root])],
+            root_name=dictionary[int(names[root])],
+            spans=[
+                (
+                    int(starts[r]),
+                    np.ascontiguousarray(sid[r]).astype(">u4").tobytes().hex(),
+                    dictionary[int(names[r])],
+                    int(batch.cols["duration_nano"][r]),
+                )
+                for r in m_rows
+            ],
+        )
+        for (cnt, tot, mn, mx) in agg_parts:
+            p.aggs.append(
+                (
+                    int(cnt[t]),
+                    float(tot[t]),
+                    float(mn[t]) if mn is not None else np.inf,
+                    float(mx[t]) if mx is not None else -np.inf,
+                )
+            )
+        out[tid_bytes] = p
+    return out
+
+
+def finalize(pipeline: A.Pipeline, partials: dict, limit: int = 20,
+             start_s: int = 0, end_s: int = 0) -> list:
+    """Merged partials -> SpansetResult list (aggregate filters applied,
+    exact trace-level time window enforced)."""
+    from tempo_tpu.traceql.engine import SpansetResult
+
+    agg_stages = [s for s in pipeline.stages[1:] if isinstance(s, A.AggregateFilter)]
+    results = []
+    for p in partials.values():
+        if start_s and p.end < start_s * 10**9:
+            continue
+        if end_s and p.start > end_s * 10**9:
+            continue
+        ok = p.matched > 0
+        for stage, (cnt, tot, mn, mx) in zip(agg_stages, p.aggs):
+            if not ok:
+                break
+            if stage.agg == "count":
+                val = p.matched
+            elif cnt == 0:
+                ok = False
+                break
+            else:
+                val = {
+                    "avg": tot / cnt,
+                    "sum": tot,
+                    "min": mn,
+                    "max": mx,
+                }[stage.agg]
+            r = stage.rhs.value
+            ok = {
+                "=": val == r,
+                "!=": val != r,
+                ">": val > r,
+                ">=": val >= r,
+                "<": val < r,
+                "<=": val <= r,
+            }[stage.op]
+        if not ok:
+            continue
+        results.append(
+            SpansetResult(
+                trace_id_hex=p.trace_id.hex(),
+                root_service_name=p.root_service,
+                root_trace_name=p.root_name,
+                start_time_unix_nano=p.start,
+                duration_ms=(p.end - p.start) // 10**6,
+                spans=[_VSpan(*s) for s in sorted(p.spans)],
+                matched_override=p.matched,
+            )
+        )
+    results.sort(key=lambda r: -r.start_time_unix_nano)
+    return results[:limit] if limit else results
+
+
+class _VSpan:
+    """Duck-typed span for SpansetResult.to_dict()."""
+
+    __slots__ = ("start_unix_nano", "_sid_hex", "name", "duration_nano")
+
+    def __init__(self, start, sid_hex, name, dur):
+        self.start_unix_nano = start
+        self._sid_hex = sid_hex
+        self.name = name
+        self.duration_nano = dur
+
+    @property
+    def span_id(self):
+        return bytes.fromhex(self._sid_hex)

@@ -217,3 +217,84 @@ class TestShardedEnginePath:
         for t in traces_a[:5] + traces_b[-5:]:
             got = blk.find_trace_by_id(t.trace_id)
             assert got is not None and got.span_count() == 6
+
+
+class TestAdvisorRegressions:
+    """Round-2 advisor findings: fingerprint bit-overlap collisions and
+    the empty-row-group refill trap."""
+
+    def test_attr_fingerprint_no_structured_collisions(self):
+        from tempo_tpu.encoding.vtpu.compactor import _attr_fingerprint
+        from tempo_tpu.model.columnar import ATTR_COLUMNS, SpanBatch, _empty_cols
+
+        def batch_with_attr(key, vstr, num=0.0, vtype=0, scope=0):
+            b = synth.make_batch(1, 1, seed=1)
+            b.attrs = {
+                "attr_span": np.zeros(1, np.uint32),
+                "attr_scope": np.array([scope], np.uint8),
+                "attr_key": np.array([key], np.uint32),
+                "attr_vtype": np.array([vtype], np.uint8),
+                "attr_str": np.array([vstr], np.uint32),
+                "attr_num": np.array([num], np.float64),
+            }
+            return b
+
+        # under the old shifted packing these collided: key<<8 == str<<16
+        # for (key=256, str=0) vs (key=0, str=1); likewise int-valued
+        # attrs where (key<<8) ^ num matched
+        pairs = [
+            ((256, 0), (0, 1)),
+            ((512, 0), (0, 2)),
+            ((1, 0), (0, 0)),
+        ]
+        for (k1, s1), (k2, s2) in pairs:
+            f1 = _attr_fingerprint(batch_with_attr(k1, s1))
+            f2 = _attr_fingerprint(batch_with_attr(k2, s2))
+            assert f1[0] != f2[0], f"collision for key/str {(k1, s1)} vs {(k2, s2)}"
+
+    def test_empty_row_group_does_not_truncate_merge(self, backend):
+        """A stream whose next row group decodes to zero spans must not
+        stop the merge while later row groups still hold data."""
+        from tempo_tpu.encoding.vtpu.compactor import VtpuCompactor
+        from tempo_tpu.model.columnar import Dictionary, SpanBatch
+
+        cfg = BlockConfig(row_group_spans=16)
+        traces = synth.make_traces(12, seed=3, spans_per_trace=4)
+        m1 = write_block_of(backend, traces[:6], cfg)
+        m2 = write_block_of(backend, traces[6:], cfg)
+
+        class HoleyStream:
+            """Duck-typed _BlockStream that injects empty batches
+            between real row groups (a corrupted/foreign block shape)."""
+
+            def __init__(self, inner):
+                self.inner = inner
+                self.pending_empty = True
+
+            def exhausted(self):
+                return self.inner.exhausted() and not self.pending_empty
+
+            def next_batch(self):
+                if self.pending_empty:
+                    self.pending_empty = False
+                    return SpanBatch(dictionary=self.inner.out_dict)
+                b = self.inner.next_batch()
+                self.pending_empty = not self.inner.exhausted()
+                return b
+
+            def close(self):
+                self.inner.close()
+
+        from tempo_tpu.encoding.vtpu.block import VtpuBackendBlock
+        from tempo_tpu.encoding.vtpu.compactor import _BlockStream
+        from tempo_tpu.encoding.vtpu.create import write_block
+
+        comp = VtpuCompactor(CompactionOptions(block_config=cfg))
+        out_dict = Dictionary()
+        streams = [
+            HoleyStream(_BlockStream(VtpuBackendBlock(m, backend, cfg), out_dict))
+            for m in (m1, m2)
+        ]
+        batches = list(comp._stream_merge(streams, out_dict, None))
+        total = sum(b.num_spans for b in batches)
+        assert total == 12 * 4, f"merge truncated: {total} of {12*4} spans"

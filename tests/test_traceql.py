@@ -309,3 +309,121 @@ class TestTimeWindow:
         assert len(got) == 2
         got = execute("{ }", fetch, limit=0)  # no window -> everything
         assert len(got) == 2
+
+
+class TestNewStages:
+    """Sibling op, by(), select(), leading aggregates, wrapped pipelines
+    (reference: OpSpansetSibling, groupOperation, expr.y BY/coalesce)."""
+
+    def test_sibling(self):
+        # child1 and child2 share parent root; grand has no sibling
+        r = run_query('{ name = "child1" } ~ { name = "child2" }')
+        assert {s.name for s in r[0].spans} == {"child2"}
+        r = run_query('{ name = "grand" } ~ { name = "grand" }')
+        assert r == []  # a span is not its own sibling
+
+    def test_sibling_requires_other_span(self):
+        r = run_query('{ name = "child2" } ~ { name = "child2" }')
+        assert r == []
+
+    def test_by_groups_then_count(self):
+        # group spans by status: error group has 1, others 3
+        r = run_query('{} | by(status) | count() >= 2')
+        # groups: status 0 (root+grand), 1 (child2), 2 (child1) -> only
+        # the status-0 group survives
+        assert {s.name for s in r[0].spans} == {"root", "grand"}
+
+    def test_by_then_coalesce_restores_all(self):
+        r = run_query('{} | by(status) | coalesce()')
+        assert len(r[0].spans) == 4
+
+    def test_by_drops_trace_when_no_group_passes(self):
+        r = run_query('{} | by(name) | count() > 1')
+        assert r == []  # every name group has exactly one span
+
+    def test_leading_count(self):
+        assert len(run_query('count() = 4')[0].spans) == 4
+        assert run_query('count() = 3') == []
+
+    def test_select_attaches_fields(self):
+        r = run_query('{ name = "child1" } | select(.level, duration)')
+        (res,) = r
+        sid = res.spans[0].span_id
+        vals = res.span_attrs[sid]
+        assert vals[".level"] == 5
+        assert vals["duration"] == 200_000_000
+        d = res.to_dict()
+        attrs = d["spanSet"]["spans"][0]["attributes"]
+        assert {"key": ".level", "value": {"intValue": "5"}} in attrs
+
+    def test_wrapped_pipeline_operand(self):
+        # lhs pipeline keeps only traces where the error-count = 1 and
+        # yields the error span; rhs children of that span
+        r = run_query('({ status = error } | count() = 1) > { duration < 20ms }')
+        assert {s.name for s in r[0].spans} == {"grand"}
+
+    def test_second_filter_stage(self):
+        r = run_query('{ duration > 40ms } | { status = error }')
+        assert {s.name for s in r[0].spans} == {"child1"}
+
+
+class TestVectorObjectParity:
+    """Vector path must agree with the object engine or fall back to it
+    (review findings: stage order, dedicated-column scopes, runtime
+    data-shape bailouts, wrapped pipeline stages)."""
+
+    def _db_with(self, traces):
+        db = TempoDB(DBConfig(backend="mock"), raw_backend=MockBackend())
+        db.write_batch("t", tr.traces_to_batch(traces).sorted_by_trace())
+        return db
+
+    def _check(self, db, traces, q):
+        got = db.traceql_search("t", q, limit=0)
+        want = execute(q, lambda spec, s, e: traces, limit=0)
+        assert {r.trace_id_hex for r in got} == {r.trace_id_hex for r in want}, q
+        gm = {r.trace_id_hex: {s.span_id for s in r.spans} for r in got}
+        wm = {r.trace_id_hex: {s.span_id for s in r.spans} for r in want}
+        assert gm == wm, q
+
+    def test_filter_after_aggregate_matches_object_engine(self):
+        t = trace_fixture()
+        db = self._db_with([t])
+        # count() must observe all 4 spans BEFORE the second filter
+        self._check(db, [t], "{} | count() = 4 | { status = error }")
+        assert db.traceql_search("t", "{} | count() = 3 | { status = error }", limit=0) == []
+
+    def test_span_scope_does_not_see_resource_service(self):
+        t = trace_fixture()
+        db = self._db_with([t])
+        # service.name lives on the resource: span-scope must not match
+        self._check(db, [t], '{ span.service.name = "svc" }')
+        self._check(db, [t], '{ resource.service.name = "svc" }')
+
+    def test_resource_scope_http_method_uses_attr_table(self):
+        t = trace_fixture()
+        # resource attr named http.method (NOT the span dedicated column)
+        t.batches[0][0]["http.method"] = "TRACE"
+        db = self._db_with([t])
+        self._check(db, [t], '{ resource.http.method = "TRACE" }')
+        self._check(db, [t], '{ span.http.method = "TRACE" }')
+
+    def test_mixed_type_attr_falls_back(self):
+        tid = b"\x07" * 16
+        mk = lambda sid, val: tr.Span(
+            trace_id=tid, span_id=sid, name="op", parent_span_id=b"\x00" * 8,
+            start_unix_nano=10**18, duration_nano=1000,
+            attributes={"flaky": val},
+        )
+        t = tr.Trace(trace_id=tid, batches=[({"service.name": "s"},
+                                             [mk(b"\x01" * 8, 1), mk(b"\x02" * 8, "one")])])
+        db = self._db_with([t])
+        # int on one span, string on the other: vector path raises
+        # Unsupported at eval time; db must fall back, not 500
+        self._check(db, [t], "{ .flaky = 1 }")
+        self._check(db, [t], '{ .flaky = "one" }')
+
+    def test_wrapped_pipeline_as_stage(self):
+        t = trace_fixture()
+        db = self._db_with([t])
+        got = db.traceql_search("t", "{ true } | ({ status = error } | count() = 1)", limit=0)
+        assert len(got) == 1 and {s.name for s in got[0].spans} == {"child1"}
