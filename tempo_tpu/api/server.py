@@ -287,6 +287,15 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/status/services":
             self._send_json(200, app.service_states() if hasattr(app, "service_states") else {"app": "Running"})
             return 200
+        if path == "/status/profile":
+            # sampling CPU profile of all threads (reference analog:
+            # net/http/pprof, cmd/tempo/main.go:57,90)
+            from tempo_tpu.util.profiling import sample_profile
+
+            seconds = float(qs.get("seconds", ["2"])[0])
+            hz = int(qs.get("hz", ["100"])[0])
+            self._send(200, sample_profile(seconds, hz).encode(), "text/plain; charset=utf-8")
+            return 200
 
         self._send_error(404, "not found")
         return 404
@@ -309,14 +318,27 @@ class _Handler(BaseHTTPRequestHandler):
         req = api_params.parse_search_request(qs)
         org = self._org_id()
         if req.query:
+            stats: dict = {}
+            t0 = time.monotonic()
             hits = self.app.traceql(
                 req.query,
                 org_id=org,
                 start_s=req.start_seconds,
                 end_s=req.end_seconds,
                 limit=req.limit,
+                stats=stats,
             )
-            doc = {"traces": [t.to_dict() for t in hits], "metrics": {}}
+            doc = {
+                "traces": [t.to_dict() for t in hits],
+                # per-query stats (reference: modules/querier/stats proto
+                # surfaced in the search response)
+                "metrics": {
+                    "inspectedTraces": stats.get("inspectedTraces", 0),
+                    "inspectedBytes": str(stats.get("inspectedBytes", 0)),
+                    "inspectedBlocks": stats.get("inspectedBlocks", 0),
+                    "elapsedMs": int((time.monotonic() - t0) * 1000),
+                },
+            }
         else:
             resp = self.app.search(req, org_id=org)
             doc = {
@@ -347,6 +369,7 @@ _ENDPOINTS = [
     "GET /status/config",
     "GET /status/services",
     "GET /status/endpoints",
+    "GET /status/profile",
 ]
 
 

@@ -243,7 +243,8 @@ class TempoDB:
         blk = self.encoding_for(meta.version).open_block(meta, self.backend, self.cfg.block)
         return blk.search(req, start_row_group=start_row_group, row_groups=row_groups)
 
-    def fetch_candidates(self, tenant: str, spec, start_s: int = 0, end_s: int = 0):
+    def fetch_candidates(self, tenant: str, spec, start_s: int = 0, end_s: int = 0,
+                         stats: dict | None = None):
         """TraceQL candidate fetch across blocks; traces straddling
         blocks are combined before the engine sees them (aggregates like
         count() must observe the whole trace)."""
@@ -251,13 +252,18 @@ class TempoDB:
 
         def job(meta):
             blk = self.encoding_for(meta.version).open_block(meta, self.backend, self.cfg.block)
-            return blk.fetch_candidates(spec, start_s, end_s)
+            out = blk.fetch_candidates(spec, start_s, end_s)
+            # bytes returned with the result: jobs run on pool threads
+            # and a shared dict bump would race
+            return out, getattr(blk, "bytes_read", 0)
 
         results, errors = self.pool.run_jobs([lambda m=m: job(m) for m in metas])
         if errors:
             raise errors[0]
         by_id: dict[bytes, list] = {}
-        for traces in results:
+        for traces, bytes_read in results:
+            if stats is not None:
+                stats["inspectedBytes"] = stats.get("inspectedBytes", 0) + bytes_read
             for t in traces:
                 by_id.setdefault(t.trace_id, []).append(t)
 
@@ -281,7 +287,7 @@ class TempoDB:
         return [combine_traces(parts) for parts in by_id.values()]
 
     def traceql_search(self, tenant: str, query: str, start_s: int = 0,
-                       end_s: int = 0, limit: int = 20):
+                       end_s: int = 0, limit: int = 20, stats: dict | None = None):
         """Execute a TraceQL query over this tenant's blocks (reference:
         traceql.Engine.Execute bridging SearchRequest -> Fetch,
         pkg/traceql/engine.go:25).
@@ -292,9 +298,19 @@ class TempoDB:
         them) before aggregate filters resolve (traceql/vector.py, the
         columnar analog of vparquet/block_traceql.go's iterator trees).
         Structural queries (parent.*, childCount, spanset ops, by,
-        select) take the exact object engine."""
+        select) take the exact object engine.
+
+        stats (optional dict) accumulates per-query observability
+        (reference: modules/querier/stats/stats.proto): inspectedBytes /
+        inspectedTraces / inspectedBlocks."""
         from tempo_tpu.traceql import execute, vector
         from tempo_tpu.traceql.parser import parse
+
+        def bump(bytes_=0, traces=0, blocks=0):
+            if stats is not None:
+                stats["inspectedBytes"] = stats.get("inspectedBytes", 0) + int(bytes_)
+                stats["inspectedTraces"] = stats.get("inspectedTraces", 0) + int(traces)
+                stats["inspectedBlocks"] = stats.get("inspectedBlocks", 0) + int(blocks)
 
         pipeline = parse(query)
         metas = [m for m in self.blocklist.metas(tenant) if _overlaps(m, start_s, end_s)]
@@ -302,13 +318,16 @@ class TempoDB:
             def job(meta):
                 blk = self.encoding_for(meta.version).open_block(meta, self.backend, self.cfg.block)
                 local: dict = {}
+                n_traces = 0
                 for view, d in blk.iter_eval_views(pipeline, start_s, end_s):
+                    firsts, _ = view.trace_boundaries()
+                    n_traces += len(firsts)
                     for tid, p in vector.evaluate_batch(pipeline, view, d).items():
                         if tid in local:
                             local[tid].merge(p)
                         else:
                             local[tid] = p
-                return local
+                return local, blk.bytes_read, n_traces
 
             results, errors = self.pool.run_jobs([lambda m=m: job(m) for m in metas])
             if any(isinstance(e, vector.Unsupported) for e in errors):
@@ -319,7 +338,8 @@ class TempoDB:
                 raise errors[0]
             else:
                 partials: dict = {}
-                for local in results:
+                for local, bytes_read, n_traces in results:
+                    bump(bytes_=bytes_read, traces=n_traces, blocks=1)
                     for tid, p in local.items():
                         if tid in partials:
                             partials[tid].merge(p)
@@ -328,7 +348,9 @@ class TempoDB:
                 return vector.finalize(pipeline, partials, limit, start_s, end_s)
 
         def fetch(spec, s, e):
-            return self.fetch_candidates(tenant, spec, s, e)
+            candidates = self.fetch_candidates(tenant, spec, s, e, stats=stats)
+            bump(traces=len(candidates), blocks=len(metas))
+            return candidates
 
         return execute(query, fetch, start_s=start_s, end_s=end_s, limit=limit)
 

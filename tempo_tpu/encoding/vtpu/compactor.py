@@ -366,7 +366,10 @@ class _ShardedTileMerger:
     """
 
     def __init__(self, mesh, plans, bucket_for):
-        from tempo_tpu.parallel.compaction import make_sharded_compactor
+        from tempo_tpu.parallel.compaction import (
+            init_sketch_accumulators,
+            make_sharded_compactor,
+        )
 
         self.mesh = mesh
         self.plans = plans
@@ -374,9 +377,9 @@ class _ShardedTileMerger:
         self.bucket_for = bucket_for
         # reuse the (window=1, range=R) sharded kernel
         self.step = make_sharded_compactor(mesh, plans)
-        self.bloom_words = None
-        self.hll_regs = None
-        self.cm_counts = None
+        # sketch accumulators live ON DEVICE across tiles; one D2H in
+        # finish() per block (round-3 verdict: no per-tile sketch syncs)
+        self._accs = init_sketch_accumulators(mesh, plans)
 
     @staticmethod
     def build(opts: CompactionOptions, metas: list[BlockMeta]) -> "_ShardedTileMerger":
@@ -402,11 +405,16 @@ class _ShardedTileMerger:
         cap = t.shape[1]
         w = self.mesh.shape["window"]
         rr = self.mesh.shape["range"]
-        shaped, keepd = self.step(
+        shaped, accs = self.step(
             jnp.asarray(t.reshape(w, rr, cap, 4)),
             jnp.asarray(s.reshape(w, rr, cap, 2)),
             jnp.asarray(v.reshape(w, rr, cap)),
+            *self._accs,
         )
+        # carry the device-resident accumulators into the next tile; no
+        # host transfer happens here (perm/keep ARE needed on host to
+        # reorder the payload columns)
+        self._accs = (accs["bloom"], accs["hll"], accs["cm"])
         perm = np.asarray(shaped["perm"]).reshape(self.r, cap)
         keep = np.asarray(shaped["keep"]).reshape(self.r, cap)
         n_valid = v.sum(axis=1)
@@ -421,37 +429,33 @@ class _ShardedTileMerger:
             keeps.append(keep[shard, :k])
         order = np.concatenate(orders) if orders else np.empty(0, np.int64)
         keepm = np.concatenate(keeps) if keeps else np.empty(0, bool)
-
-        # tile partials -> block sketches. psum/pmax only reduce over the
-        # range axis; with a multi-window mesh each window holds the merge
-        # of its own shard subset, so complete the merge across windows on
-        # host (OR/max/add are associative).
-        tb = np.bitwise_or.reduce(np.asarray(keepd["bloom"]), axis=0)
-        th = np.asarray(keepd["hll"]).max(axis=0)
-        tc = np.asarray(keepd["cm"]).sum(axis=0, dtype=np.uint32)
-        if self.bloom_words is None:
-            self.bloom_words, self.hll_regs, self.cm_counts = tb, th, tc
-        else:
-            self.bloom_words = self.bloom_words | tb
-            self.hll_regs = np.maximum(self.hll_regs, th)
-            self.cm_counts = self.cm_counts + tc
         return order, keepm
 
     def finish(self) -> dict:
-        """Block-level sketches for write_block (post all tiles).
+        """Block-level sketches for write_block (post all tiles) — the
+        ONLY device->host sketch transfer of the whole job.
+
+        psum/pmax reduce over the range axis on device; with a
+        multi-window mesh each window's accumulator holds the merge of
+        its own shard subset, so the final cross-window OR/max/add (tiny
+        arrays) happens here on host.
 
         hll_regs/cm_counts ride along for callers beyond write_block
         (hot-trace detection feeding max_spans_per_trace, bench recall
         accounting): cm holds psum-merged span counts per trace key.
         """
-        est = 0.0
-        if self.hll_regs is not None:
-            est = float(sketch.hll_estimate(jnp.asarray(self.hll_regs), self.plans.hll))
+        import jax
+
+        bloom_acc, hll_acc, cm_acc = jax.device_get(self._accs)
+        bloom_words = np.bitwise_or.reduce(np.asarray(bloom_acc), axis=0)
+        hll_regs = np.asarray(hll_acc).max(axis=0)
+        cm_counts = np.asarray(cm_acc).sum(axis=0, dtype=np.uint32)
+        est = float(sketch.hll_estimate(jnp.asarray(hll_regs), self.plans.hll))
         return {
             "bloom_plan": self.plans.bloom,
-            "bloom_words": self.bloom_words,
-            "hll_regs": self.hll_regs,
-            "cm_counts": self.cm_counts,
+            "bloom_words": bloom_words,
+            "hll_regs": hll_regs,
+            "cm_counts": cm_counts,
             "est_distinct": int(est),
         }
 

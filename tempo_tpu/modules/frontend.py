@@ -166,7 +166,8 @@ class Frontend:
         return out
 
     # ------------------------------------------------------------------
-    def traceql(self, tenant: str, query: str, start_s=0, end_s=0, limit=20):
+    def traceql(self, tenant: str, query: str, start_s=0, end_s=0, limit=20,
+                stats: dict | None = None):
         # parse up front: a malformed query is a client error and must
         # fail before any job is sharded or retried (reference: the
         # frontend's search middleware parses before enqueueing)
@@ -181,6 +182,9 @@ class Frontend:
             raise errors[0]
         out = []
         for r in results:
+            if stats is not None:
+                for k, v in r.get("metrics", {}).items():
+                    stats[k] = stats.get(k, 0) + int(v)
             for t in r.get("results", []):
                 out.append(
                     TraceSearchMetadata(

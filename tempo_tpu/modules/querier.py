@@ -145,8 +145,9 @@ class Querier:
             out |= batch_tag_values(batch, tag)
         return sorted(out)
 
-    def traceql(self, tenant: str, query: str, start_s=0, end_s=0, limit=20):
-        results = self.db.traceql_search(tenant, query, start_s, end_s, limit)
+    def traceql(self, tenant: str, query: str, start_s=0, end_s=0, limit=20,
+                stats: dict | None = None):
+        results = self.db.traceql_search(tenant, query, start_s, end_s, limit, stats=stats)
         # include candidates from live ingester data
         from tempo_tpu.traceql import execute
 

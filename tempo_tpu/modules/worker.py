@@ -57,10 +57,12 @@ def execute_job(querier, tenant: str, desc: dict) -> dict:
             resp.merge(querier.search_block_job(tenant, block_id, req), limit=req.limit)
         return {"response": resp.to_dict()}
     if kind == "traceql":
+        stats: dict = {}
         hits = querier.traceql(
-            tenant, desc["q"], desc.get("start", 0), desc.get("end", 0), desc.get("limit", 20)
+            tenant, desc["q"], desc.get("start", 0), desc.get("end", 0),
+            desc.get("limit", 20), stats=stats,
         )
-        return {"results": [h.to_dict() for h in hits]}
+        return {"results": [h.to_dict() for h in hits], "metrics": stats}
     raise ValueError(f"unknown job kind {kind!r}")
 
 

@@ -106,24 +106,47 @@ def make_sharded_compactor(mesh, plans: CompactionPlans):
 
     Outputs: per-shard merge plans sharded as inputs; sketches and totals
     replicated across the range axis (one copy per window).
+
+    The sketch outputs are ACCUMULATORS: the psum/pmax-merged tile
+    sketches fold into the carried (W, ...) accumulator arrays on
+    device, so a multi-tile compaction job never moves sketch words to
+    the host until finish() — one D2H per block, not per tile
+    (round-3 verdict item 3: kill the per-tile syncs).
     """
 
-    def step(tids, sids, valid):
+    def step(tids, sids, valid, bloom_acc, hll_acc, cm_acc):
         # blocks arrive with leading (1, 1) window/range dims; squeeze them
         out = local_compaction_step(tids[0, 0], sids[0, 0], valid[0, 0], plans, RANGE_AXIS)
         sharded = {k: out[k][None, None] for k in ("perm", "keep", "n_rows", "n_traces")}
-        replicated = {k: out[k][None] for k in ("total_rows", "total_traces", "bloom", "hll", "cm")}
-        return sharded, replicated
+        accs = {
+            "bloom": (bloom_acc[0] | out["bloom"])[None],
+            "hll": jnp.maximum(hll_acc[0], out["hll"])[None],
+            "cm": (cm_acc[0] + out["cm"])[None],
+            "total_rows": out["total_rows"][None],
+            "total_traces": out["total_traces"][None],
+        }
+        return sharded, accs
 
     spec_in = P(WINDOW_AXIS, RANGE_AXIS)
+    spec_acc = P(WINDOW_AXIS)
     return jax.jit(
         shard_map(
             step,
             mesh=mesh,
-            in_specs=(spec_in, spec_in, spec_in),
+            in_specs=(spec_in, spec_in, spec_in, spec_acc, spec_acc, spec_acc),
             out_specs=(P(WINDOW_AXIS, RANGE_AXIS), P(WINDOW_AXIS)),
             check_vma=False,
         )
+    )
+
+
+def init_sketch_accumulators(mesh, plans: CompactionPlans):
+    """Zeroed (W, ...) device accumulators for make_sharded_compactor."""
+    w = mesh.shape[WINDOW_AXIS]
+    return (
+        jnp.zeros((w, plans.bloom.n_shards, plans.bloom.words_per_shard), jnp.uint32),
+        jnp.zeros((w, plans.hll.m), jnp.uint32),
+        jnp.zeros((w, plans.cm.depth, plans.cm.width), jnp.uint32),
     )
 
 

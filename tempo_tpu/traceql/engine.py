@@ -241,14 +241,21 @@ def _to_result(trace, matched, ctx, select_exprs=()) -> SpansetResult:
                     vals[_select_label(e)] = v
             if vals:
                 attrs[s.span_id] = vals
+    # same retention cap + ordering rule as the vector path
+    # (vector.MAX_SPANS_PER_RESULT): earliest by (start, span_id), true
+    # matched count carried separately
+    from tempo_tpu.traceql.vector import MAX_SPANS_PER_RESULT
+
+    kept = sorted(matched, key=lambda s: (s.start_unix_nano, s.span_id))
     return SpansetResult(
         trace_id_hex=trace.trace_id.hex(),
         root_service_name=ctx.resource_of(root).get("service.name", ""),
         root_trace_name=root.name,
         start_time_unix_nano=start,
         duration_ms=(end - start) // 10**6,
-        spans=sorted(matched, key=lambda s: s.start_unix_nano),
+        spans=kept[:MAX_SPANS_PER_RESULT],
         span_attrs=attrs,
+        matched_override=len(matched),
     )
 
 

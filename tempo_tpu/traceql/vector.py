@@ -40,9 +40,10 @@ from tempo_tpu.model.columnar import (
 )
 from tempo_tpu.traceql import ast_nodes as A
 
-MAX_SPANS_PER_RESULT = 20  # spans shown per trace in the HTTP response
-# (all matched spans are retained in partials — the object engine does the
-# same, and downstream combining needs them)
+MAX_SPANS_PER_RESULT = 20  # spans retained per trace in results — both
+# engines apply the same cap (earliest by start, span_id tiebreak) with
+# the true matched count carried separately, so memory stays bounded by
+# limit*cap instead of total matched spans
 
 
 class Unsupported(Exception):
@@ -66,11 +67,10 @@ class ColumnView:
 
     def trace_boundaries(self):
         if self._tb is None:
-            tid = self.cols["trace_id"]
-            new = np.ones(self._n, dtype=bool)
-            new[1:] = (tid[1:] != tid[:-1]).any(axis=1)
-            seg = np.cumsum(new) - 1
-            self._tb = (np.flatnonzero(new), seg)
+            from tempo_tpu.model.columnar import trace_segmentation
+
+            _, seg, firsts = trace_segmentation(self.cols["trace_id"])
+            self._tb = (firsts, seg)
         return self._tb
 
 
@@ -385,12 +385,18 @@ def _eval_binary(e: A.Binary, ctx: _Ctx):
         return ("bool", ~eq & both, np.ones(n, bool))
 
     if op in (">", ">=", "<", "<="):
+        if lk == "str" or rk == "str":
+            # Python compares strings lexicographically; codes don't.
+            # Bail so the object engine answers exactly.
+            raise Unsupported("string ordering comparison")
         if lk != "num" or rk != "num":
             return ("bool", np.zeros(n, bool), np.ones(n, bool))
         cmp = {">": lv > rv, ">=": lv >= rv, "<": lv < rv, "<=": lv <= rv}[op]
         return ("bool", cmp & both, np.ones(n, bool))
 
     if op in A.ARITH_OPS:
+        if lk == "str" or rk == "str":
+            raise Unsupported("string arithmetic")
         if lk != "num" or rk != "num":
             return (None, None, np.zeros(n, bool))
         with np.errstate(divide="ignore", invalid="ignore", over="ignore"):
@@ -463,6 +469,8 @@ class TracePartial:
     end: int = 0
     root_service: str = ""
     root_name: str = ""
+    has_root: bool = False  # root_* comes from a TRUE root span, not the
+    # first-span fallback — a real root in a later block must win
     spans: list = field(default_factory=list)  # (start, span_id_hex, name, dur)
 
     def merge(self, other: "TracePartial"):
@@ -472,10 +480,12 @@ class TracePartial:
             self.aggs[i] = (c0 + c, t0 + t, min(mn0, mn), max(mx0, mx))
         self.start = min(self.start, other.start)
         self.end = max(self.end, other.end)
-        if not self.root_service and other.root_service:
+        if other.has_root and not self.has_root:
             self.root_service = other.root_service
             self.root_name = other.root_name
-        self.spans.extend(other.spans)
+            self.has_root = True
+        if len(self.spans) < MAX_SPANS_PER_RESULT:
+            self.spans = sorted(self.spans + other.spans)[:MAX_SPANS_PER_RESULT]
 
 
 def evaluate_batch(pipeline: A.Pipeline, batch, dictionary) -> dict:
@@ -542,7 +552,14 @@ def evaluate_batch(pipeline: A.Pipeline, batch, dictionary) -> dict:
         tid_bytes = np.ascontiguousarray(tid[lo]).astype(">u4").tobytes()
         roots = rows[is_root[lo:hi]]
         root = int(roots[0]) if len(roots) else lo
+        # cap retained spans (earliest by start, span_id tiebreak — same
+        # rule as the object engine); matched keeps the true count
         m_rows = rows[mask[lo:hi]]
+        if len(m_rows) > MAX_SPANS_PER_RESULT:
+            key = np.lexsort((
+                sid[m_rows, 1], sid[m_rows, 0], starts[m_rows],
+            ))
+            m_rows = m_rows[key[:MAX_SPANS_PER_RESULT]]
         p = TracePartial(
             trace_id=tid_bytes,
             matched=int(m_count[t]),
@@ -550,6 +567,7 @@ def evaluate_batch(pipeline: A.Pipeline, batch, dictionary) -> dict:
             end=int(ends[rows].max()),
             root_service=dictionary[int(service[root])],
             root_name=dictionary[int(names[root])],
+            has_root=bool(len(roots)),
             spans=[
                 (
                     int(starts[r]),
@@ -620,7 +638,7 @@ def finalize(pipeline: A.Pipeline, partials: dict, limit: int = 20,
                 root_trace_name=p.root_name,
                 start_time_unix_nano=p.start,
                 duration_ms=(p.end - p.start) // 10**6,
-                spans=[_VSpan(*s) for s in sorted(p.spans)],
+                spans=[_VSpan(*s) for s in sorted(p.spans)[:MAX_SPANS_PER_RESULT]],
                 matched_override=p.matched,
             )
         )

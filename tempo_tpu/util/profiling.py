@@ -1,0 +1,54 @@
+"""In-process sampling profiler for the /status/profile endpoint.
+
+Reference analog: the reference serves net/http/pprof and exposes mutex
+profiling flags (cmd/tempo/main.go:57,90). The Python equivalent here
+samples every live thread's stack via sys._current_frames() at a fixed
+rate for a bounded window and aggregates frame hit counts — the same
+shape of answer a pprof CPU profile gives ("where is time going right
+now"), with no interpreter-wide tracing overhead while idle.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import Counter
+
+
+def sample_profile(seconds: float = 5.0, hz: int = 100, top: int = 40) -> str:
+    """Sample all thread stacks for `seconds`; returns a text report of
+    the hottest frames and the hottest whole stacks."""
+    seconds = max(0.1, min(float(seconds), 60.0))
+    interval = 1.0 / max(1, min(int(hz), 1000))
+    me = threading.get_ident()
+    frame_hits: Counter = Counter()
+    stack_hits: Counter = Counter()
+    samples = 0
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            stack = []
+            f = frame
+            while f is not None and len(stack) < 30:
+                co = f.f_code
+                entry = f"{co.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno}:{co.co_name}"
+                stack.append(entry)
+                f = f.f_back
+            if not stack:
+                continue
+            frame_hits[stack[0]] += 1
+            stack_hits[";".join(reversed(stack[:10]))] += 1
+            samples += 1
+        time.sleep(interval)
+
+    lines = [f"# sampling profile: {seconds:.1f}s @ {hz}Hz, {samples} thread-samples"]
+    lines.append("\n## hottest frames (leaf)")
+    for entry, n in frame_hits.most_common(top):
+        lines.append(f"{n:6d}  {entry}")
+    lines.append("\n## hottest stacks (root->leaf, truncated)")
+    for stack, n in stack_hits.most_common(10):
+        lines.append(f"{n:6d}  {stack}")
+    return "\n".join(lines) + "\n"

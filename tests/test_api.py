@@ -272,3 +272,29 @@ class TestTraceQLOverHTTP:
         status, body, _ = _get(f"{server.url}/api/search?q={q}")
         assert status == 200
         assert trace.trace_id.hex() in {t["traceID"] for t in json.loads(body)["traces"]}
+
+    def test_traceql_metrics_populated(self, served_app):
+        """The TraceQL path must return per-query stats, not '{}'
+        (reference: modules/querier/stats surfaced in search responses)."""
+        app, server = served_app
+        trace = make_trace(seed=11, n_spans=4)
+        _post(f"{server.url}/v1/traces", otlp.encode_traces_request([trace]), "application/x-protobuf")
+        app.sweep_all(immediate=True)  # cut + complete + flush to backend
+        app.db.poll_now()
+        q = urllib.parse.quote("{}")
+        status, body, _ = _get(f"{server.url}/api/search?q={q}")
+        assert status == 200
+        m = json.loads(body)["metrics"]
+        assert m["inspectedBlocks"] >= 1
+        assert m["inspectedTraces"] >= 1
+        assert int(m["inspectedBytes"]) > 0
+        assert "elapsedMs" in m
+
+
+class TestProfileEndpoint:
+    def test_sampling_profile(self, served_app):
+        _, server = served_app
+        status, body, _ = _get(f"{server.url}/status/profile?seconds=0.3&hz=50")
+        assert status == 200
+        text = body.decode()
+        assert "sampling profile" in text and "hottest frames" in text

@@ -61,8 +61,19 @@ def test_sharded_equals_ground_truth():
         s[i, :, :c] = sw
         v[i, :, :c] = vw
 
+    from tempo_tpu.parallel.compaction import init_sketch_accumulators
+
     step = make_sharded_compactor(mesh, plans)
-    sharded, repl = step(jnp.asarray(t), jnp.asarray(s), jnp.asarray(v))
+    accs = init_sketch_accumulators(mesh, plans)
+    sharded, repl = step(jnp.asarray(t), jnp.asarray(s), jnp.asarray(v), *accs)
+    # accumulator semantics: running the SAME tile again folds into the
+    # carried sketches (idempotent for bloom-OR / hll-max, additive cm)
+    sharded2, repl2 = step(
+        jnp.asarray(t), jnp.asarray(s), jnp.asarray(v),
+        repl["bloom"], repl["hll"], repl["cm"],
+    )
+    assert np.array_equal(np.asarray(repl2["bloom"]), np.asarray(repl["bloom"]))
+    assert np.array_equal(np.asarray(repl2["hll"]), np.asarray(repl["hll"]))
 
     for i in range(w):
         gt = merge.np_merge_spans(tids[i * half : (i + 1) * half], sids[i * half : (i + 1) * half])

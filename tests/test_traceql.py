@@ -427,3 +427,36 @@ class TestVectorObjectParity:
         db = self._db_with([t])
         got = db.traceql_search("t", "{ true } | ({ status = error } | count() = 1)", limit=0)
         assert len(got) == 1 and {s.name for s in got[0].spans} == {"child1"}
+
+    def test_string_ordering_falls_back(self):
+        t = trace_fixture()
+        db = self._db_with([t])
+        # lexicographic name comparison: vector must bail to object path
+        self._check(db, [t], '{ name > "childZ" }')
+        self._check(db, [t], '{ name <= "child1" }')
+
+    def test_cross_block_root_name(self):
+        tid = b"\x09" * 16
+        mk = lambda sid, name, parent, svc: tr.Trace(
+            trace_id=tid,
+            batches=[({"service.name": svc},
+                      [tr.Span(trace_id=tid, span_id=sid, name=name,
+                               parent_span_id=parent, start_unix_nano=10**18,
+                               duration_nano=1000)])],
+        )
+        root_sid, child_sid = b"\x01" * 8, b"\x02" * 8
+        db = TempoDB(DBConfig(backend="mock"), raw_backend=MockBackend())
+        # block 1 holds only the CHILD; block 2 holds the true root
+        db.write_batch("t", tr.traces_to_batch([mk(child_sid, "child", root_sid, "svc-child")]).sorted_by_trace())
+        db.write_batch("t", tr.traces_to_batch([mk(root_sid, "THEROOT", b"\x00" * 8, "svc-root")]).sorted_by_trace())
+        (got,) = db.traceql_search("t", "{}", limit=0)
+        assert got.root_trace_name == "THEROOT"
+        assert got.root_service_name == "svc-root"
+
+    def test_object_fallback_reports_bytes(self):
+        t = trace_fixture()
+        db = self._db_with([t])
+        stats = {}
+        db.traceql_search("t", "{} | by(status)", limit=0, stats=stats)  # by() -> object path
+        assert stats.get("inspectedBytes", 0) > 0
+        assert stats.get("inspectedBlocks", 0) >= 1

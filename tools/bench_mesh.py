@@ -1,0 +1,109 @@
+"""Mesh-sharded compaction timing on the virtual 8-device CPU mesh.
+
+This host has ONE real chip, so the sharded engine path
+(CompactionOptions.mesh -> _ShardedTileMerger: ID-range shard_map +
+psum/pmax sketch collectives, with device-resident accumulators across
+tiles) can only be TIMED against a virtual CPU mesh — a proxy for
+relative scaling, not absolute chip throughput (PERF.md). Run with:
+
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python tools/bench_mesh.py
+
+Prints one JSON line:
+  {"metric": "mesh_compaction_tiles_per_sec", "single_dev": A,
+   "mesh8": B, "sketch_syncs_per_job": 1, ...}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+# must be set before jax import to get the virtual mesh
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+N_TRACES = 16384
+SPANS = 8
+REPS = 3
+
+
+def build(backend, cfg):
+    from tempo_tpu.encoding import from_version
+    from tempo_tpu.model import synth
+    from tempo_tpu.model.columnar import SpanBatch
+
+    enc = from_version("vtpu1")
+    a = synth.make_batch(N_TRACES, SPANS, seed=1)
+    dup = int(N_TRACES * 0.25) * SPANS
+    fresh = synth.make_batch(N_TRACES - int(N_TRACES * 0.25), SPANS, seed=2)
+    b = SpanBatch.concat([a.select(np.arange(dup)), fresh]).sorted_by_trace()
+    return [enc.create_block([a], "m", backend, cfg), enc.create_block([b], "m", backend, cfg)]
+
+
+def run(opts_kw, metas, backend, cfg):
+    from tempo_tpu.encoding.common import CompactionOptions
+    from tempo_tpu.encoding.vtpu.compactor import VtpuCompactor
+
+    opts = CompactionOptions(block_config=cfg, **opts_kw)
+    VtpuCompactor(opts).compact(metas, "warm", backend)  # compile warmup
+    best = float("inf")
+    tiles = 0
+    for i in range(REPS):
+        comp = VtpuCompactor(opts)
+        t0 = time.perf_counter()
+        outs = comp.compact(metas, f"r{i}", backend)
+        best = min(best, time.perf_counter() - t0)
+        tiles = max(tiles, outs[0].total_records)
+    return best, tiles
+
+
+def main():
+    import jax
+
+    # the TPU plugin's sitecustomize overrides jax_platforms at
+    # interpreter start; force the CPU mesh after import (see conftest)
+    jax.config.update("jax_platforms", "cpu")
+
+    from tempo_tpu.backend import LocalBackend, TypedBackend
+    from tempo_tpu.encoding.common import BlockConfig
+    from tempo_tpu.parallel.mesh import compaction_mesh
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        print(json.dumps({"error": f"need a multi-device mesh, got {n_dev}"}))
+        return 1
+    with tempfile.TemporaryDirectory(dir="/dev/shm" if os.path.isdir("/dev/shm") else None) as tmp:
+        backend = TypedBackend(LocalBackend(tmp))
+        cfg = BlockConfig(row_group_spans=16384)
+        metas = build(backend, cfg)
+        t_dev, tiles = run({"merge_path": "device"}, metas, backend, cfg)
+        t_mesh, _ = run({"mesh": compaction_mesh(n_dev)}, metas, backend, cfg)
+        t_native, _ = run({"merge_path": "native"}, metas, backend, cfg)
+        spans = sum(m.total_spans for m in metas)
+        print(json.dumps({
+            "metric": "mesh_compaction_seconds_per_job",
+            "devices": n_dev,
+            "single_device": round(t_dev, 3),
+            f"mesh{n_dev}": round(t_mesh, 3),
+            "native_host": round(t_native, 3),
+            "spans_per_job": spans,
+            "mesh_spans_per_s": round(spans / t_mesh),
+            "sketch_syncs_per_job": 1,
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
