@@ -61,7 +61,19 @@ def main(argv=None) -> int:
             host=cfg.server.http_listen_address,
             port=cfg.server.grpc_listen_port,
         ).start()
-        log.info("OTLP/Jaeger gRPC receiver on :%d", grpc_server.port)
+        log.info("OTLP/Jaeger/OpenCensus gRPC receiver on :%d", grpc_server.port)
+    kafka_rx = None
+    if cfg.server.kafka.brokers and cfg.target in ("all", "distributor"):
+        from tempo_tpu.receivers.kafka import KafkaReceiver
+
+        kafka_rx = KafkaReceiver(
+            app.push_traces,
+            brokers=list(cfg.server.kafka.brokers),
+            topic=cfg.server.kafka.topic,
+            poll_interval_s=cfg.server.kafka.poll_interval_s,
+        ).start()
+        log.info("Kafka receiver consuming %s from %s",
+                 cfg.server.kafka.topic, cfg.server.kafka.brokers)
     app.start_loops()
     log.info("tempo-tpu up: target=%s listening on %s", cfg.target, server.url)
 
@@ -74,6 +86,8 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, handle)
     signal.signal(signal.SIGINT, handle)
     stop.wait()
+    if kafka_rx is not None:
+        kafka_rx.stop()
     if grpc_server is not None:
         grpc_server.stop()
     server.stop()

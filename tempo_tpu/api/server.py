@@ -292,8 +292,11 @@ class _Handler(BaseHTTPRequestHandler):
             # net/http/pprof, cmd/tempo/main.go:57,90)
             from tempo_tpu.util.profiling import sample_profile
 
-            seconds = float(qs.get("seconds", ["2"])[0])
-            hz = int(qs.get("hz", ["100"])[0])
+            try:
+                seconds = float(qs.get("seconds", ["2"])[0])
+                hz = int(qs.get("hz", ["100"])[0])
+            except ValueError as e:
+                raise BadRequest(f"bad profile params: {e}") from e
             self._send(200, sample_profile(seconds, hz).encode(), "text/plain; charset=utf-8")
             return 200
 

@@ -13,6 +13,7 @@ from tempo_tpu.cache.client import (
     LRUCache,
     MemcachedCache,
     MockCache,
+    RedisCache,
 )
 
-__all__ = ["Cache", "LRUCache", "MemcachedCache", "BackgroundCache", "MockCache"]
+__all__ = ["Cache", "LRUCache", "MemcachedCache", "RedisCache", "BackgroundCache", "MockCache"]

@@ -83,6 +83,31 @@ class MockCache(LRUCache):
         super().__init__(max_bytes=1 << 62)
 
 
+def _server_for(addresses: list[str], key: str) -> str:
+    """Shared consistent server selection: jump-less modular choice over
+    fnv32 — consistent enough for a static server list (the reference
+    rebuilds its ring on DNS changes). Both the memcached and redis
+    clients MUST use this same function or key placement splits."""
+    h = 2166136261
+    for c in key.encode():
+        h = ((h ^ c) * 16777619) & 0xFFFFFFFF
+    return addresses[h % len(addresses)]
+
+
+def _tally(keys: list[str], got: dict) -> tuple[list[str], list[bytes], list[str]]:
+    """Order-preserving (found, bufs, missed) + hit/miss metrics."""
+    found, bufs, missed = [], [], []
+    for k in keys:
+        if k in got:
+            found.append(k)
+            bufs.append(got[k])
+            cache_hits.inc()
+        else:
+            missed.append(k)
+            cache_misses.inc()
+    return found, bufs, missed
+
+
 class MemcachedCache(Cache):
     """Minimal memcached text-protocol client with a consistent-hash
     server selector (reference: pkg/cache/memcached_client.go uses
@@ -99,12 +124,7 @@ class MemcachedCache(Cache):
         self._lock = threading.Lock()
 
     def _server_for(self, key: str) -> str:
-        # jump-less modular selection over fnv32 — consistent enough for a
-        # static server list (the reference rebuilds its ring on DNS changes)
-        h = 2166136261
-        for c in key.encode():
-            h = ((h ^ c) * 16777619) & 0xFFFFFFFF
-        return self.addresses[h % len(self.addresses)]
+        return _server_for(self.addresses, key)
 
     def _conn(self, addr: str) -> socket.socket:
         s = self._conns.get(addr)
@@ -136,7 +156,6 @@ class MemcachedCache(Cache):
                     self._conns.pop(addr, None)
 
     def fetch(self, keys):
-        found, bufs, missed = [], [], []
         by_server: dict[str, list[str]] = {}
         for k in keys:
             by_server.setdefault(self._server_for(k), []).append(k)
@@ -159,15 +178,7 @@ class MemcachedCache(Cache):
                         got[parts[1].decode()] = data
                 except OSError:
                     self._conns.pop(addr, None)
-        for k in keys:
-            if k in got:
-                found.append(k)
-                bufs.append(got[k])
-                cache_hits.inc()
-            else:
-                missed.append(k)
-                cache_misses.inc()
-        return found, bufs, missed
+        return _tally(keys, got)
 
     def stop(self) -> None:
         with self._lock:
@@ -177,6 +188,126 @@ class MemcachedCache(Cache):
                 except OSError:
                     pass
             self._conns.clear()
+
+
+class RedisCache(Cache):
+    """Minimal Redis client speaking RESP2 (SET [EX ttl] / MGET) with the
+    same consistent server selection as the memcached client
+    (reference: tempodb/backend/cache/redis/ + pkg/cache/redis_*.go,
+    which wrap go-redis; here the wire protocol is hand-rolled like the
+    rest of this repo's clients).
+    """
+
+    def __init__(self, addresses: list[str], ttl_s: int = 0, timeout_s: float = 0.5):
+        if not addresses:
+            raise ValueError("redis: at least one address required")
+        self.addresses = addresses
+        self.ttl_s = ttl_s
+        self.timeout_s = timeout_s
+        self._conns: dict[str, tuple[socket.socket, object]] = {}
+        self._lock = threading.Lock()
+
+    # -- selection / connections (same scheme as memcached) -------------
+    def _server_for(self, key: str) -> str:
+        return _server_for(self.addresses, key)
+
+    def _conn(self, addr: str):
+        pair = self._conns.get(addr)
+        if pair is not None:
+            return pair
+        host, port = addr.rsplit(":", 1)
+        s = socket.create_connection((host, int(port)), timeout=self.timeout_s)
+        pair = (s, s.makefile("rb"))
+        self._conns[addr] = pair
+        return pair
+
+    # -- RESP2 wire ------------------------------------------------------
+    @staticmethod
+    def _cmd(*parts: bytes) -> bytes:
+        out = bytearray(b"*%d\r\n" % len(parts))
+        for p in parts:
+            out += b"$%d\r\n%s\r\n" % (len(p), p)
+        return bytes(out)
+
+    def _reply(self, f):
+        """Parse one RESP reply -> bytes | int | None | list | error str."""
+        line = f.readline()
+        if not line:
+            raise OSError("redis: connection closed")
+        kind, rest = line[:1], line[1:].rstrip(b"\r\n")
+        if kind == b"+":
+            return rest
+        if kind == b"-":
+            raise OSError(f"redis error: {rest.decode(errors='replace')}")
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n < 0:
+                return None
+            data = f.read(n)
+            f.read(2)  # \r\n
+            return data
+        if kind == b"*":
+            n = int(rest)
+            if n < 0:
+                return None
+            return [self._reply(f) for _ in range(n)]
+        raise OSError(f"redis: bad reply type {kind!r}")
+
+    # -- Cache interface --------------------------------------------------
+    def store(self, keys, bufs) -> None:
+        by_server: dict[str, list[tuple[str, bytes]]] = {}
+        for k, b in zip(keys, bufs):
+            by_server.setdefault(self._server_for(k), []).append((k, b))
+        with self._lock:
+            for addr, kvs in by_server.items():
+                try:
+                    s, f = self._conn(addr)
+                    # pipeline all SETs, then read all replies
+                    msg = bytearray()
+                    for k, b in kvs:
+                        if self.ttl_s:
+                            msg += self._cmd(b"SET", k.encode(), b, b"EX", str(self.ttl_s).encode())
+                        else:
+                            msg += self._cmd(b"SET", k.encode(), b)
+                    s.sendall(bytes(msg))
+                    for _ in kvs:
+                        self._reply(f)
+                except OSError:
+                    self._drop(addr)
+
+    def fetch(self, keys):
+        by_server: dict[str, list[str]] = {}
+        for k in keys:
+            by_server.setdefault(self._server_for(k), []).append(k)
+        got: dict[str, bytes] = {}
+        with self._lock:
+            for addr, ks in by_server.items():
+                try:
+                    s, f = self._conn(addr)
+                    s.sendall(self._cmd(b"MGET", *[k.encode() for k in ks]))
+                    vals = self._reply(f)
+                    if isinstance(vals, list):
+                        for k, v in zip(ks, vals):
+                            if v is not None:
+                                got[k] = v
+                except OSError:
+                    self._drop(addr)
+        return _tally(keys, got)
+
+    def _drop(self, addr: str) -> None:
+        pair = self._conns.pop(addr, None)
+        if pair is not None:
+            try:
+                pair[0].close()
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        with self._lock:
+            for addr in list(self._conns):
+                self._drop(addr)
 
 
 class BackgroundCache(Cache):

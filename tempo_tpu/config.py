@@ -37,12 +37,23 @@ _ENV_RE = re.compile(r"\$\{(\w+)(?::([^}]*))?\}")
 
 
 @dataclass
+class KafkaReceiverConfig:
+    """Kafka ingest (reference: the shim's kafka receiver factory,
+    encoding=otlp_proto); empty brokers disables."""
+
+    brokers: list = field(default_factory=list)
+    topic: str = "otlp_spans"
+    poll_interval_s: float = 0.25
+
+
+@dataclass
 class ServerConfig:
     http_listen_address: str = "127.0.0.1"
     http_listen_port: int = 3200
-    # OTLP/Jaeger gRPC ingest (reference: receiver shim port 4317, the
-    # default protocol of OTel SDKs/collectors); 0 disables
+    # OTLP/Jaeger/OpenCensus gRPC ingest (reference: receiver shim port
+    # 4317, the default protocol of OTel SDKs/collectors); 0 disables
     grpc_listen_port: int = 0
+    kafka: KafkaReceiverConfig = field(default_factory=KafkaReceiverConfig)
     log_level: str = "info"
 
 

@@ -72,14 +72,21 @@ class TempoDB:
             # app sharing one store across ingesters) arrive pre-wrapped
             if cfg.cache != "none":
                 from tempo_tpu.backend.cache import CachedBackend
-                from tempo_tpu.cache import BackgroundCache, LRUCache, MemcachedCache
+                from tempo_tpu.cache import (
+                    BackgroundCache,
+                    LRUCache,
+                    MemcachedCache,
+                    RedisCache,
+                )
 
                 if cfg.cache == "memory":
                     cache_client = LRUCache(**cfg.cache_options)
                 elif cfg.cache == "memcached":
                     cache_client = MemcachedCache(**cfg.cache_options)
+                elif cfg.cache == "redis":
+                    cache_client = RedisCache(**cfg.cache_options)
                 else:
-                    raise ValueError(f"unknown cache {cfg.cache!r} (have none|memory|memcached)")
+                    raise ValueError(f"unknown cache {cfg.cache!r} (have none|memory|memcached|redis)")
                 if cfg.cache_background_writes:
                     cache_client = BackgroundCache(cache_client)
                 self._cache_client = cache_client

@@ -29,6 +29,7 @@ log = logging.getLogger(__name__)
 
 OTLP_EXPORT_METHOD = "/opentelemetry.proto.collector.trace.v1.TraceService/Export"
 JAEGER_POST_SPANS_METHOD = "/jaeger.api_v2.CollectorService/PostSpans"
+OPENCENSUS_EXPORT_METHOD = "/opencensus.proto.agent.trace.v1.TraceService/Export"
 DEFAULT_GRPC_PORT = 4317  # reference: the OTLP collector default
 
 _ORG_ID_KEYS = ("x-scope-orgid",)
@@ -170,6 +171,9 @@ class TraceGrpcServer:
                     return grpc.unary_unary_rpc_method_handler(outer._export_otlp)
                 if details.method == JAEGER_POST_SPANS_METHOD:
                     return grpc.unary_unary_rpc_method_handler(outer._post_spans)
+                if details.method == OPENCENSUS_EXPORT_METHOD:
+                    # OC agent Export is a bidirectional stream
+                    return grpc.stream_stream_rpc_method_handler(outer._export_oc)
                 return None
 
         self.server = grpc.server(
@@ -209,6 +213,22 @@ class TraceGrpcServer:
             context.abort(self._grpc.StatusCode.INVALID_ARGUMENT, f"bad OTLP payload: {e}")
         self._ingest(traces, context)
         return b""  # ExportTraceServiceResponse{} (no partial_success)
+
+    def _export_oc(self, request_iterator, context):
+        """OpenCensus agent stream: each message is an
+        ExportTraceServiceRequest; respond with one empty
+        ExportTraceServiceResponse per message (reference: the shim's
+        "opencensus" receiver factory, shim.go:110-133)."""
+        from tempo_tpu.receivers import opencensus
+
+        for request in request_iterator:
+            try:
+                traces = opencensus.decode_export_request(request)
+            except Exception as e:
+                context.abort(self._grpc.StatusCode.INVALID_ARGUMENT, f"bad OC payload: {e}")
+            if traces:
+                self._ingest(traces, context)
+            yield b""
 
     def _post_spans(self, request: bytes, context) -> bytes:
         try:

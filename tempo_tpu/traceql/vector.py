@@ -484,8 +484,10 @@ class TracePartial:
             self.root_service = other.root_service
             self.root_name = other.root_name
             self.has_root = True
-        if len(self.spans) < MAX_SPANS_PER_RESULT:
-            self.spans = sorted(self.spans + other.spans)[:MAX_SPANS_PER_RESULT]
+        # unconditional sorted-union-truncate: both sides are already
+        # capped, and the kept set must be the globally earliest spans
+        # regardless of block merge order
+        self.spans = sorted(self.spans + other.spans)[:MAX_SPANS_PER_RESULT]
 
 
 def evaluate_batch(pipeline: A.Pipeline, batch, dictionary) -> dict:

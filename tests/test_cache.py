@@ -9,7 +9,7 @@ import threading
 
 from tempo_tpu.backend.cache import CacheControl, CachedBackend
 from tempo_tpu.backend.mock import MockBackend
-from tempo_tpu.cache import BackgroundCache, LRUCache, MemcachedCache, MockCache
+from tempo_tpu.cache import BackgroundCache, LRUCache, MemcachedCache, MockCache, RedisCache
 
 
 class CountingBackend(MockBackend):
@@ -162,3 +162,117 @@ class TestCachedBackend:
         assert be.read_range("data.bin", ("t", "b"), 2, 4) == b"2345"
         assert be.read_range("data.bin", ("t", "b"), 2, 4) == b"2345"
         assert inner.n_reads == 1
+
+
+class _FakeRedis:
+    """Minimal RESP2 server: SET key val [EX ttl], MGET, pipelining."""
+
+    def __init__(self):
+        self.data = {}
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.addr = f"127.0.0.1:{self.sock.getsockname()[1]}"
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,), daemon=True).start()
+
+    def _read_cmd(self, f):
+        line = f.readline()
+        if not line:
+            return None
+        assert line[:1] == b"*", line
+        n = int(line[1:].strip())
+        parts = []
+        for _ in range(n):
+            hdr = f.readline()
+            assert hdr[:1] == b"$", hdr
+            size = int(hdr[1:].strip())
+            parts.append(f.read(size))
+            f.read(2)
+        return parts
+
+    def _handle(self, conn):
+        f = conn.makefile("rb")
+        while True:
+            cmd = self._read_cmd(f)
+            if cmd is None:
+                return
+            op = cmd[0].upper()
+            if op == b"SET":
+                self.data[cmd[1]] = cmd[2]
+                conn.sendall(b"+OK\r\n")
+            elif op == b"MGET":
+                out = bytearray(b"*%d\r\n" % (len(cmd) - 1))
+                for k in cmd[1:]:
+                    v = self.data.get(k)
+                    if v is None:
+                        out += b"$-1\r\n"
+                    else:
+                        out += b"$%d\r\n%s\r\n" % (len(v), v)
+                conn.sendall(bytes(out))
+            else:
+                conn.sendall(b"-ERR unknown command\r\n")
+
+    def close(self):
+        self.sock.close()
+
+
+class TestRedis:
+    def test_store_fetch_roundtrip(self):
+        srv = _FakeRedis()
+        c = RedisCache([srv.addr])
+        c.store(["k1", "k2"], [b"v1", b"binary\x00\r\nstuff"])
+        found, bufs, missed = c.fetch(["k1", "k2", "k3"])
+        assert found == ["k1", "k2"]
+        assert bufs == [b"v1", b"binary\x00\r\nstuff"]
+        assert missed == ["k3"]
+        c.stop()
+        srv.close()
+
+    def test_ttl_sent_as_ex(self):
+        srv = _FakeRedis()
+        c = RedisCache([srv.addr], ttl_s=30)
+        c.store(["k"], [b"v"])
+        found, bufs, _ = c.fetch(["k"])
+        assert found == ["k"] and bufs == [b"v"]
+        c.stop()
+        srv.close()
+
+    def test_sharding_across_servers(self):
+        srvs = [_FakeRedis() for _ in range(3)]
+        c = RedisCache([s.addr for s in srvs])
+        keys = [f"key-{i}" for i in range(40)]
+        c.store(keys, [f"val-{i}".encode() for i in range(40)])
+        found, bufs, missed = c.fetch(keys)
+        assert not missed and len(found) == 40
+        per_server = [len(s.data) for s in srvs]
+        assert all(n > 0 for n in per_server), per_server  # spread out
+        assert sum(per_server) == 40
+        c.stop()
+        for s in srvs:
+            s.close()
+
+    def test_down_server_degrades_to_miss(self):
+        c = RedisCache(["127.0.0.1:1"], timeout_s=0.1)  # nothing listening
+        c.store(["k"], [b"v"])  # swallowed
+        found, bufs, missed = c.fetch(["k"])
+        assert found == [] and missed == ["k"]
+        c.stop()
+
+    def test_behind_cached_backend(self):
+        srv = _FakeRedis()
+        inner = CountingBackend()
+        inner.write("bloom-0", ("t", "blk"), b"words")
+        cached = CachedBackend(inner, RedisCache([srv.addr]))
+        assert cached.read("bloom-0", ("t", "blk")) == b"words"
+        n = inner.n_reads
+        assert cached.read("bloom-0", ("t", "blk")) == b"words"
+        assert inner.n_reads == n  # second read served from redis
+        srv.close()

@@ -66,27 +66,32 @@ def test_sharded_equals_ground_truth():
     step = make_sharded_compactor(mesh, plans)
     accs = init_sketch_accumulators(mesh, plans)
     sharded, repl = step(jnp.asarray(t), jnp.asarray(s), jnp.asarray(v), *accs)
+    # snapshot BEFORE reusing: the accumulator args are donated, so the
+    # first call's buffers are invalid after they are passed back in
+    bloom1 = np.asarray(repl["bloom"]).copy()
+    hll1 = np.asarray(repl["hll"]).copy()
     # accumulator semantics: running the SAME tile again folds into the
     # carried sketches (idempotent for bloom-OR / hll-max, additive cm)
     sharded2, repl2 = step(
         jnp.asarray(t), jnp.asarray(s), jnp.asarray(v),
         repl["bloom"], repl["hll"], repl["cm"],
     )
-    assert np.array_equal(np.asarray(repl2["bloom"]), np.asarray(repl["bloom"]))
-    assert np.array_equal(np.asarray(repl2["hll"]), np.asarray(repl["hll"]))
+    assert np.array_equal(np.asarray(repl2["bloom"]), bloom1)
+    assert np.array_equal(np.asarray(repl2["hll"]), hll1)
 
     for i in range(w):
         gt = merge.np_merge_spans(tids[i * half : (i + 1) * half], sids[i * half : (i + 1) * half])
         assert int(np.asarray(repl["total_rows"])[i]) == gt["n_rows"]
         assert int(np.asarray(repl["total_traces"])[i]) == gt["n_traces"]
 
-    # merged bloom: no false negatives for window-0 ids
+    # merged bloom: no false negatives for window-0 ids (bloom1/hll1 are
+    # the pre-donation snapshots)
     ids0 = np.unique(tids[:half], axis=0)
-    words = jnp.asarray(np.asarray(repl["bloom"][0]))
+    words = jnp.asarray(bloom1[0])
     assert bool(np.asarray(bloom.test(words, jnp.asarray(ids0), plans.bloom)).all())
 
     # merged HLL within 10%
-    est = float(sketch.hll_estimate(jnp.asarray(np.asarray(repl["hll"][0])), plans.hll))
+    est = float(sketch.hll_estimate(jnp.asarray(hll1[0]), plans.hll))
     exact = len(ids0)
     assert abs(est - exact) / exact < 0.1
 

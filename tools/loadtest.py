@@ -1,0 +1,385 @@
+"""Sustained load test against a real multi-process tempo-tpu cluster.
+
+Reference: integration/bench/load_test.go:19 runs k6 against an
+all-in-one deployment with scripted thresholds
+(smoke_test.js:39-45: write success >99%, read success >90%,
+p99 < 1.5s). This is that harness natively: it spawns a cluster of
+`python -m tempo_tpu` OS processes (distributor + RF=2 ingesters +
+query-frontend/querier sharing a ring over the netkv control plane),
+sweeps one trace through EVERY ingest protocol (OTLP proto+json,
+Zipkin JSON, Jaeger thrift, and the gRPC trio OTLP/Jaeger/OpenCensus
+when grpcio is present), then drives concurrent writer/reader virtual
+users for --duration seconds and emits ONE pass/fail JSON line.
+
+Usage:
+  python tools/loadtest.py --duration 120 --writers 4 --readers 2
+  python tools/loadtest.py --url http://host:3200 ...   # existing cluster
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.smoke import HTTPTarget, Thresholds, run_smoke  # noqa: E402
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _cfg(tmp, target, port, instance, kv_url, grpc_port=0, extra=""):
+    grpc = f"\n  grpc_listen_port: {grpc_port}" if grpc_port else ""
+    return f"""
+target: {target}
+server:
+  http_listen_address: 127.0.0.1
+  http_listen_port: {port}{grpc}
+storage:
+  trace:
+    backend: local
+    backend_path: {tmp}/blocks
+    wal_path: {tmp}/wal
+    blocklist_poll_s: 5
+replication_factor: 2
+instance_id: {instance}
+ring_kv_url: {kv_url}
+advertise_addr: http://127.0.0.1:{port}
+ring_heartbeat_timeout_s: 10
+ingester:
+  max_trace_idle_s: 1.0
+  flush_check_period_s: 1.0
+metrics_generator:
+  enabled: false
+{extra}
+"""
+
+
+class Proc:
+    def __init__(self, tmp, target, name, kv_url, grpc_port=0, extra=""):
+        self.name = name
+        self.port = _free_port()
+        self.url = f"http://127.0.0.1:{self.port}"
+        cfg_path = f"{tmp}/{name}.yaml"
+        with open(cfg_path, "w") as f:
+            f.write(_cfg(tmp, target, self.port, name, kv_url, grpc_port, extra))
+        self.log = open(f"{tmp}/{name}.log", "w")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "tempo_tpu", f"-config.file={cfg_path}"],
+            stdout=self.log, stderr=subprocess.STDOUT, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+
+    def wait_ready(self, timeout=90):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(f"{self.name} exited rc={self.proc.returncode}")
+            try:
+                with urllib.request.urlopen(self.url + "/ready", timeout=2) as r:
+                    if r.status == 200:
+                        return self
+            except (urllib.error.URLError, OSError):
+                time.sleep(0.3)
+        raise TimeoutError(f"{self.name} not ready")
+
+    def terminate(self):
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        self.log.close()
+
+
+def start_cluster(tmp: str, grpc_port: int = 0) -> tuple[list[Proc], Proc, Proc]:
+    """-> (all procs, frontend/query entry, distributor entry).
+
+    The frontend hosts the ring KV service ("local") and every other
+    role joins through it — the same bootstrap the multi-process e2e
+    test uses."""
+    front = Proc(tmp, "query-frontend", "front", kv_url="local")
+    front.wait_ready()
+    kv_url = front.url
+    procs = [front]
+    procs.append(Proc(tmp, "ingester", "ing-a", kv_url))
+    procs.append(Proc(tmp, "ingester", "ing-b", kv_url))
+    dist = Proc(tmp, "distributor", "dist", kv_url, grpc_port=grpc_port)
+    procs.append(dist)
+    procs.append(Proc(tmp, "querier", "querier", kv_url,
+                      extra=f"frontend_address: {kv_url}\n"))
+    for p in procs[1:]:
+        p.wait_ready()
+    time.sleep(1.0)  # let ring heartbeats settle
+    return procs, front, dist
+
+
+# ---------------------------------------------------------------------------
+# receiver sweep: one trace through every ingest protocol
+# ---------------------------------------------------------------------------
+
+
+def _post(url, path, body, ct, headers=None):
+    req = urllib.request.Request(
+        url + path, data=body, method="POST",
+        headers={"Content-Type": ct, **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=15) as r:
+        return r.status
+
+
+def receiver_sweep(dist_url: str, query_url: str, grpc_port: int = 0) -> dict:
+    """Returns {protocol: 'ok'|'skipped'|error string}; each protocol
+    must land a queryable trace (reference: receivers e2e test,
+    integration/e2e/receivers_test.go:35)."""
+    import random
+    import struct
+
+    from tempo_tpu.model import synth
+    from tempo_tpu.receivers import jaeger, otlp
+
+    results: dict = {}
+    sent: dict[str, bytes] = {}
+    seed0 = random.randint(1, 1 << 30)
+
+    def one_trace(i):
+        (t,) = synth.make_traces(1, seed=seed0 + i, spans_per_trace=3)
+        return t
+
+    # OTLP HTTP protobuf
+    t = one_trace(1)
+    try:
+        _post(dist_url, "/v1/traces", otlp.encode_traces_request([t]), "application/x-protobuf")
+        sent["otlp_http_proto"] = t.trace_id
+    except Exception as e:
+        results["otlp_http_proto"] = f"error: {e}"
+    # OTLP HTTP JSON
+    t = one_trace(2)
+    try:
+        _post(dist_url, "/v1/traces", json.dumps(otlp.encode_traces_json([t])).encode(),
+              "application/json")
+        sent["otlp_http_json"] = t.trace_id
+    except Exception as e:
+        results["otlp_http_json"] = f"error: {e}"
+    # Zipkin JSON (the v2 list-of-spans shape)
+    t = one_trace(3)
+    try:
+        spans_json = []
+        for span in t.all_spans():
+            spans_json.append({
+                "traceId": t.trace_id.hex(),
+                "id": span.span_id.hex(),
+                "parentId": span.parent_span_id.hex() if span.parent_span_id != b"\x00" * 8 else None,
+                "name": span.name,
+                "timestamp": span.start_unix_nano // 1000,
+                "duration": max(1, span.duration_nano // 1000),
+                "localEndpoint": {"serviceName": t.batches[0][0].get("service.name", "svc")},
+                "tags": {},
+            })
+        _post(dist_url, "/api/v2/spans", json.dumps(spans_json).encode(), "application/json")
+        sent["zipkin_json"] = t.trace_id
+    except Exception as e:
+        results["zipkin_json"] = f"error: {e}"
+    # Jaeger thrift-binary batch (minimal writer, mirrors the decoder's
+    # field ids in receivers/jaeger.py)
+    t = one_trace(4)
+    try:
+        def tstr(out, fid, s):
+            b = s.encode()
+            out += struct.pack(">bh", jaeger.T_STRING, fid) + struct.pack(">i", len(b)) + b
+
+        def ti64(out, fid, v):
+            out += struct.pack(">bhq", jaeger.T_I64, fid, v)
+
+        def tstruct_spans(trace):
+            spans_b = bytearray()
+            for span in trace.all_spans():
+                s = bytearray()
+                tid_hi = int.from_bytes(trace.trace_id[:8], "big", signed=False)
+                tid_lo = int.from_bytes(trace.trace_id[8:], "big", signed=False)
+                ti64(s, 1, tid_lo - (1 << 64) if tid_lo >= 1 << 63 else tid_lo)
+                ti64(s, 2, tid_hi - (1 << 64) if tid_hi >= 1 << 63 else tid_hi)
+                sid = int.from_bytes(span.span_id, "big", signed=False)
+                ti64(s, 3, sid - (1 << 64) if sid >= 1 << 63 else sid)
+                pid = int.from_bytes(span.parent_span_id, "big", signed=False)
+                ti64(s, 4, pid - (1 << 64) if pid >= 1 << 63 else pid)
+                tstr(s, 5, span.name)
+                ti64(s, 8, span.start_unix_nano // 1000)
+                ti64(s, 9, max(1, span.duration_nano // 1000))
+                s.append(jaeger.T_STOP)
+                spans_b += s
+            return spans_b, sum(1 for _ in trace.all_spans())
+
+        batch = bytearray()
+        proc = bytearray()
+        tstr(proc, 1, t.batches[0][0].get("service.name", "svc"))
+        proc.append(jaeger.T_STOP)
+        batch += struct.pack(">bh", jaeger.T_STRUCT, 1) + proc
+        spans_b, n = tstruct_spans(t)
+        batch += struct.pack(">bh", jaeger.T_LIST, 2)
+        batch += struct.pack(">bi", jaeger.T_STRUCT, n)
+        batch += spans_b
+        batch.append(jaeger.T_STOP)
+        _post(dist_url, "/api/traces", bytes(batch), "application/vnd.apache.thrift.binary")
+        sent["jaeger_thrift"] = t.trace_id
+    except Exception as e:
+        results["jaeger_thrift"] = f"error: {e}"
+
+    # gRPC receivers (OTLP unary + OpenCensus stream; Jaeger rides its
+    # HTTP thrift form above)
+    if grpc_port:
+        try:
+            import grpc
+
+            from tempo_tpu.receivers.grpc_server import OTLP_EXPORT_METHOD
+
+            chan = grpc.insecure_channel(f"127.0.0.1:{grpc_port}")
+            t = one_trace(5)
+            chan.unary_unary(OTLP_EXPORT_METHOD,
+                             request_serializer=lambda b: b,
+                             response_deserializer=lambda b: b)(
+                otlp.encode_traces_request([t]), timeout=15)
+            sent["otlp_grpc"] = t.trace_id
+        except ImportError:
+            results["otlp_grpc"] = "skipped"
+        except Exception as e:
+            results["otlp_grpc"] = f"error: {e}"
+        try:
+            import grpc
+
+            from tempo_tpu.receivers.grpc_server import OPENCENSUS_EXPORT_METHOD
+            from tempo_tpu.receivers import protowire
+
+            # minimal OC request for the sweep
+            t = one_trace(7)
+            span0 = next(iter(t.all_spans()))
+            body = bytearray()
+            sp = bytearray()
+            protowire.put_bytes_field(sp, 1, span0.trace_id)
+            protowire.put_bytes_field(sp, 2, span0.span_id)
+            name = bytearray()
+            protowire.put_str_field(name, 1, span0.name)
+            protowire.put_bytes_field(sp, 4, bytes(name))
+            ts = bytearray()
+            protowire.put_varint_field(ts, 1, span0.start_unix_nano // 10**9)
+            protowire.put_varint_field(ts, 2, span0.start_unix_nano % 10**9)
+            protowire.put_bytes_field(sp, 5, bytes(ts))
+            te = bytearray()
+            end = span0.start_unix_nano + span0.duration_nano
+            protowire.put_varint_field(te, 1, end // 10**9)
+            protowire.put_varint_field(te, 2, end % 10**9)
+            protowire.put_bytes_field(sp, 6, bytes(te))
+            protowire.put_bytes_field(body, 2, bytes(sp))
+            chan = grpc.insecure_channel(f"127.0.0.1:{grpc_port}")
+            call = chan.stream_stream(OPENCENSUS_EXPORT_METHOD,
+                                      request_serializer=lambda b: b,
+                                      response_deserializer=lambda b: b)
+            list(call(iter([bytes(body)])))
+            sent["opencensus_grpc"] = span0.trace_id
+        except ImportError:
+            results["opencensus_grpc"] = "skipped"
+        except Exception as e:
+            results["opencensus_grpc"] = f"error: {e}"
+
+    # verify every sent trace is queryable
+    deadline = time.time() + 30
+    pending = dict(sent)
+    while pending and time.time() < deadline:
+        for proto, tid in list(pending.items()):
+            try:
+                req = urllib.request.Request(
+                    f"{query_url}/api/traces/{tid.hex()}",
+                    headers={"Accept": "application/protobuf"})
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    if r.status == 200:
+                        results[proto] = "ok"
+                        del pending[proto]
+            except (urllib.error.URLError, OSError):
+                pass
+        if pending:
+            time.sleep(0.5)
+    for proto in pending:
+        results[proto] = "error: not queryable within 30s"
+    return results
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--url", help="existing cluster URL (skips spawning)")
+    ap.add_argument("--duration", type=float, default=120.0)
+    ap.add_argument("--writers", type=int, default=4)
+    ap.add_argument("--readers", type=int, default=2)
+    ap.add_argument("--spans-per-trace", type=int, default=5)
+    ap.add_argument("--skip-sweep", action="store_true")
+    args = ap.parse_args()
+
+    procs: list[Proc] = []
+    tmpdir = None
+    try:
+        grpc_port = 0
+        try:
+            import grpc  # noqa: F401
+
+            grpc_port = _free_port()
+        except ImportError:
+            pass
+        if args.url:
+            write_url = query_url = args.url
+        else:
+            tmpdir = tempfile.mkdtemp(prefix="tempo-loadtest-")
+            procs, front, dist = start_cluster(tmpdir, grpc_port=grpc_port)
+            write_url, query_url = dist.url, front.url
+            print(f"[loadtest] cluster up: write={write_url} query={query_url}",
+                  file=sys.stderr)
+
+        sweep = {}
+        if not args.skip_sweep:
+            sweep = receiver_sweep(write_url, query_url, grpc_port=grpc_port if procs else 0)
+            print(f"[loadtest] receiver sweep: {sweep}", file=sys.stderr)
+
+        target = HTTPTarget(write_url)
+        # reads go to the frontend (sharded path), writes to the distributor
+        read_target = HTTPTarget(query_url)
+
+        class SplitTarget:
+            def write(self, traces):
+                return target.write(traces)
+
+            def read(self, trace_id):
+                return read_target.read(trace_id)
+
+        summary = run_smoke(
+            SplitTarget(),
+            duration_s=args.duration,
+            writers=args.writers,
+            readers=args.readers,
+            spans_per_trace=args.spans_per_trace,
+            thresholds=Thresholds(),
+        )
+        summary["receiver_sweep"] = sweep
+        sweep_ok = all(v in ("ok", "skipped") for v in sweep.values()) if sweep else True
+        summary["passed"] = bool(summary["passed"] and sweep_ok)
+        print(json.dumps(summary))
+        return 0 if summary["passed"] else 1
+    finally:
+        for p in procs:
+            p.terminate()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
