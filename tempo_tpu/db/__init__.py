@@ -155,6 +155,18 @@ class TempoDB:
             self._compaction_mesh = mesh
         return self._compaction_mesh
 
+    def mesh_searcher(self):
+        """Lazy sharded multi-block searcher (None without a mesh)."""
+        if getattr(self, "_mesh_searcher", None) is None:
+            mesh = self.compaction_mesh()
+            if mesh is None:
+                self._mesh_searcher = False
+            else:
+                from tempo_tpu.parallel.search import MeshSearcher
+
+                self._mesh_searcher = MeshSearcher(mesh, self.cfg.block.bucket_for)
+        return self._mesh_searcher or None
+
     # ------------------------------------------------------------------
     # writer
     # ------------------------------------------------------------------
@@ -217,11 +229,24 @@ class TempoDB:
     def search(self, tenant: str, req: SearchRequest) -> SearchResponse:
         """Tag search across blocks overlapping the request window
         (reference: tempodb.Search:357; sharding happens above us in the
-        frontend, P4)."""
+        frontend, P4).
+
+        With a device mesh, multi-block batches route through the
+        sharded scan (parallel/search.MeshSearcher): row groups from
+        many blocks stack over the mesh, each device scans its shard
+        with the fused predicate kernel, and decoded predicate columns
+        stay in a bytes-bounded cache across queries."""
         metas = [
             m for m in self.blocklist.metas(tenant)
             if _overlaps(m, req.start_seconds, req.end_seconds)
         ]
+        searcher = self.mesh_searcher()
+        if searcher is not None and len(metas) > 1 and all(m.version == "vtpu1" for m in metas):
+            blocks = (
+                self.encoding_for(m.version).open_block(m, self.backend, self.cfg.block)
+                for m in metas
+            )  # lazy: blocks past a satisfied limit are never opened
+            return searcher.search_blocks(blocks, req)
         out = SearchResponse()
 
         def job(meta):

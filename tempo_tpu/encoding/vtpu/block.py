@@ -217,8 +217,15 @@ class VtpuBackendBlock:
 
         if not span_mask.any():
             return []
+        return self.hits_for_mask(rg, span_mask, req, limit, have_cols=cols)
 
-        # phase 2: metadata pages, only now that something matched
+    def hits_for_mask(self, rg, span_mask: np.ndarray, req, limit: int = 0,
+                      have_cols: dict | None = None) -> list[TraceSearchMetadata]:
+        """Phase 2 of search: fetch metadata pages and roll a span hit
+        mask up to TraceSearchMetadata (also the mesh scan's collector —
+        the device produces the mask, this builds the hits)."""
+        n = rg.n_spans
+        cols = dict(have_cols or {})
         cols.update(self.read_columns(rg, sorted(set(_META_COLS) - set(cols))))
 
         # roll up to traces (any span matched), honoring time window
@@ -229,9 +236,9 @@ class VtpuBackendBlock:
         starts = cols["start_unix_nano"]
         ends = starts + cols["duration_nano"]
         if req.start_seconds:
-            span_mask &= ends >= np.uint64(req.start_seconds * 10**9)
+            span_mask = span_mask & (ends >= np.uint64(req.start_seconds * 10**9))
         if req.end_seconds:
-            span_mask &= starts <= np.uint64(req.end_seconds * 10**9)
+            span_mask = span_mask & (starts <= np.uint64(req.end_seconds * 10**9))
 
         n_traces = int(seg[-1]) + 1
         trace_hit = hit_trace_mask(seg, span_mask, n_traces)

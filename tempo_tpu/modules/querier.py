@@ -100,6 +100,26 @@ class Querier:
         return self.db.search_block(tenant, block_id, req,
                                     start_row_group=start_row_group, row_groups=row_groups)
 
+    def search_block_batch(self, tenant: str, block_ids: list, req: SearchRequest) -> SearchResponse:
+        """One frontend job = a batch of blocks. With a device mesh the
+        whole batch goes through the sharded scan in stacked dispatches
+        (parallel/search.MeshSearcher — reference P4,
+        modules/frontend/searchsharding.go:266-314); otherwise blocks
+        scan serially like the reference's per-job loop."""
+        searcher = self.db.mesh_searcher() if not self.external_endpoints else None
+        if searcher is not None and len(block_ids) > 1:
+            metas = [self.db.backend.block_meta(tenant, bid) for bid in block_ids]
+            if all(m.version == "vtpu1" for m in metas):
+                blocks = (
+                    self.db.encoding_for(m.version).open_block(m, self.db.backend, self.db.cfg.block)
+                    for m in metas
+                )  # lazy: early-exit skips opening later blocks
+                return searcher.search_blocks(blocks, req)
+        resp = SearchResponse()
+        for block_id in block_ids:
+            resp.merge(self.search_block_job(tenant, block_id, req), limit=req.limit)
+        return resp
+
     def _search_external(self, tenant, block_id, req, start_row_group, row_groups) -> SearchResponse:
         """Delegate one block-search job to a serverless endpoint."""
         import urllib.parse

@@ -52,9 +52,7 @@ def execute_job(querier, tenant: str, desc: dict) -> dict:
         return {"response": querier.search_recent(tenant, req).to_dict()}
     if kind == "search_blocks":
         req = SearchRequest.from_dict(desc["search"])
-        resp = SearchResponse()
-        for block_id in desc["block_ids"]:
-            resp.merge(querier.search_block_job(tenant, block_id, req), limit=req.limit)
+        resp = querier.search_block_batch(tenant, desc["block_ids"], req)
         return {"response": resp.to_dict()}
     if kind == "traceql":
         stats: dict = {}

@@ -109,6 +109,232 @@ def make_sharded_bloom_test(mesh, p: bloom.BloomPlan):
     )
 
 
+def make_sharded_tag_scan_per_shard(mesh, n_cols: int, max_codes: int = 64):
+    """Like make_sharded_tag_scan, but the accepted code sets are
+    SHARDED with the rows: codes (W, R, C, K). Needed when shards come
+    from different blocks — each block resolves the same string
+    predicate to its own dictionary codes."""
+
+    def local(cols, codes, valid):
+        hit = valid
+        for c in range(n_cols):
+            col = cols[c]
+            ok = jnp.zeros(col.shape, bool)
+            for k in range(max_codes):
+                code = codes[c, k]
+                ok = ok | ((col == code) & (code != jnp.uint32(0xFFFFFFFF)))
+            hit = hit & ok
+        count = jnp.sum(hit.astype(jnp.int32))
+        total = jax.lax.psum(count, RANGE_AXIS)
+        return hit, total
+
+    def step(cols, codes, valid):
+        hit, total = local(cols[0, 0], codes[0, 0], valid[0, 0])
+        return hit[None, None], total[None, None]
+
+    spec = P(WINDOW_AXIS, RANGE_AXIS)
+    return jax.jit(
+        shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=(spec, P(WINDOW_AXIS)),
+            check_vma=False,
+        )
+    )
+
+
+class MeshSearcher:
+    """Mesh-sharded multi-block tag search with a bytes-bounded column
+    cache (reference P4 + the async column iterator's page economy,
+    modules/frontend/searchsharding.go:266-314 /
+    pkg/parquetquery/iters.go:246).
+
+    Each dispatch stacks up to W*R (block, row-group) units on the mesh;
+    every device runs the fused equality-set scan over its shard with
+    that shard's OWN dictionary codes, hit masks come back sharded, and
+    only matching shards pay the host-side metadata phase. Decoded
+    predicate columns are cached (host memory, LRU by bytes) so repeated
+    queries against hot blocks skip the ranged read + decode entirely.
+    """
+
+    def __init__(self, mesh, bucket_for, max_cache_bytes: int = 256 << 20,
+                 max_codes: int = 64):
+        import threading
+        from collections import OrderedDict
+
+        self.mesh = mesh
+        self.w = mesh.shape[WINDOW_AXIS]
+        self.r = mesh.shape[RANGE_AXIS]
+        self.bucket_for = bucket_for
+        self.max_codes = max_codes
+        self.max_cache_bytes = max_cache_bytes
+        self._scans: dict = {}  # n_cols -> jitted per-shard scan
+        self._cache: OrderedDict = OrderedDict()  # (block, rg_i, col) -> np col
+        self._cache_bytes = 0
+        # one searcher serves every request thread of the HTTP server —
+        # the LRU bookkeeping must not race
+        self._cache_lock = threading.Lock()
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+    # -- column cache ----------------------------------------------------
+    def _col(self, blk, rg_index: int, rg, name: str) -> np.ndarray:
+        key = (blk.meta.block_id, rg_index, name)
+        with self._cache_lock:
+            hit = self._cache.get(key)
+            if hit is not None:
+                self._cache.move_to_end(key)
+                self.cache_hits += 1
+                return hit
+            self.cache_misses += 1
+        col = blk.read_columns(rg, [name])[name].astype(np.uint32, copy=False)
+        with self._cache_lock:
+            self._cache[key] = col
+            self._cache_bytes += col.nbytes
+            while self._cache_bytes > self.max_cache_bytes and self._cache:
+                _, evicted = self._cache.popitem(last=False)
+                self._cache_bytes -= evicted.nbytes
+        return col
+
+    def _scan(self, n_cols: int):
+        fn = self._scans.get(n_cols)
+        if fn is None:
+            fn = make_sharded_tag_scan_per_shard(self.mesh, n_cols, self.max_codes)
+            self._scans[n_cols] = fn
+        return fn
+
+    # -- search ----------------------------------------------------------
+    def search_blocks(self, blocks, req) -> "object":
+        """blocks: ITERABLE of lazily-opened VtpuBackendBlocks — a block
+        is only opened (index + dictionary reads) when the scan actually
+        reaches it, so limited queries over large tenants keep the old
+        path's early-exit economy. Device path covers the span_eq
+        predicates; duration/attr predicates AND in host-side on matched
+        shards only. Results get the same dedupe / newest-first /
+        limit discipline as SearchResponse.merge."""
+        from tempo_tpu.encoding.common import SearchResponse
+        from tempo_tpu.encoding.vtpu.block import _resolve_tag_predicates
+
+        resp = SearchResponse()
+        opened: list = []
+        hits: list = []
+        seen_ids: set = set()
+        cap = self.w * self.r
+        pending: list = []  # (blk, rg_index, rg, preds)
+        done = False
+
+        def unique_hits() -> int:
+            return len(seen_ids)
+
+        def flush(chunk):
+            nonlocal done
+            if not chunk:
+                return
+            n_cols = max(len(p["span_eq"]) for _, _, _, p in chunk)
+            if n_cols == 0:
+                # no device-scannable predicate: plain per-row-group scan
+                for blk, i, rg, preds in chunk:
+                    resp.inspected_traces += rg.n_traces
+                    for h in blk._search_row_group(rg, req, preds, limit=0):
+                        if h.trace_id_hex not in seen_ids:
+                            seen_ids.add(h.trace_id_hex)
+                            hits.append(h)
+                    if req.limit and unique_hits() >= req.limit:
+                        done = True
+                        return
+                return
+            scan = self._scan(n_cols)
+            pad = self.bucket_for(max(rg.n_spans for _, _, rg, _ in chunk))
+            cols = np.zeros((cap, n_cols, pad), np.uint32)
+            codes = np.full((cap, n_cols, self.max_codes), NO_MATCH, np.uint32)
+            valid = np.zeros((cap, pad), bool)
+            for s, (blk, i, rg, preds) in enumerate(chunk):
+                for c, (col_name, accept) in enumerate(preds["span_eq"]):
+                    cols[s, c, : rg.n_spans] = self._col(blk, i, rg, col_name)
+                    k = min(len(accept), self.max_codes)
+                    codes[s, c, :k] = accept[:k]
+                for c in range(len(preds["span_eq"]), n_cols):
+                    # unit has fewer predicates than the widest: accept-all
+                    codes[s, c, 0] = 0
+                valid[s, : rg.n_spans] = True
+            masks, _totals = scan(
+                jnp.asarray(cols.reshape(self.w, self.r, n_cols, pad)),
+                jnp.asarray(codes.reshape(self.w, self.r, n_cols, self.max_codes)),
+                jnp.asarray(valid.reshape(self.w, self.r, pad)),
+            )
+            masks_np = np.asarray(masks).reshape(cap, pad)
+            for s, (blk, i, rg, preds) in enumerate(chunk):
+                resp.inspected_traces += rg.n_traces
+                span_mask = masks_np[s, : rg.n_spans].copy()
+                if not span_mask.any():
+                    continue
+                span_mask &= self._host_predicates(blk, rg, req, preds)
+                if not span_mask.any():
+                    continue
+                for h in blk.hits_for_mask(rg, span_mask, req, 0):
+                    if h.trace_id_hex not in seen_ids:
+                        seen_ids.add(h.trace_id_hex)
+                        hits.append(h)
+                if req.limit and unique_hits() >= req.limit:
+                    done = True
+                    return
+
+        for blk in blocks:
+            if done:
+                break
+            opened.append(blk)
+            resp.inspected_blocks += 1
+            preds = _resolve_tag_predicates(req, blk.dictionary())
+            if preds is None:
+                continue  # impossible in this block: no more IO for it
+            for i, rg in enumerate(blk.index().row_groups):
+                if req.start_seconds and rg.end_s < req.start_seconds:
+                    continue
+                if req.end_seconds and rg.start_s > req.end_seconds:
+                    continue
+                pending.append((blk, i, rg, preds))
+                if len(pending) >= cap:
+                    flush(pending)
+                    pending = []
+                    if done:
+                        break
+        if not done:
+            flush(pending)
+
+        # same result discipline as SearchResponse.merge: newest first,
+        # truncated to the limit (dedupe already applied via seen_ids)
+        hits.sort(key=lambda t: -t.start_time_unix_nano)
+        resp.traces = hits[: req.limit] if req.limit else hits
+        # inspected bytes = actual IO of every opened block (cache hits
+        # cost no IO and are deliberately not counted)
+        resp.inspected_bytes = sum(b.bytes_read for b in opened)
+        return resp
+
+    @staticmethod
+    def _host_predicates(blk, rg, req, preds) -> np.ndarray:
+        """Duration + attr predicates the device scan does not cover."""
+        n = rg.n_spans
+        mask = np.ones(n, bool)
+        if req.min_duration_ns or req.max_duration_ns:
+            dur = blk.read_columns(rg, ["duration_nano"])["duration_nano"]
+            if req.min_duration_ns:
+                mask &= dur >= np.uint64(req.min_duration_ns)
+            if req.max_duration_ns:
+                mask &= dur <= np.uint64(req.max_duration_ns)
+        if preds["attr"]:
+            from tempo_tpu.model.columnar import VT_STR
+
+            attrs = blk.read_columns(rg, ["attr_span", "attr_key", "attr_vtype", "attr_str"])
+            is_str = attrs["attr_vtype"] == VT_STR
+            for key_code, val_codes in preds["attr"]:
+                arow = (attrs["attr_key"] == key_code) & is_str & np.isin(attrs["attr_str"], val_codes)
+                ok = np.zeros(n, bool)
+                ok[attrs["attr_span"][arow]] = True
+                mask &= ok
+        return mask
+
+
 NO_MATCH = np.uint32(0xFFFFFFFF)
 
 

@@ -25,7 +25,17 @@ import threading
 log = logging.getLogger(__name__)
 
 API_FETCH = 1
+API_LIST_OFFSETS = 2
 API_METADATA = 3
+
+ERR_OFFSET_OUT_OF_RANGE = 1
+
+
+class KafkaFetchError(Exception):
+    def __init__(self, partition: int, code: int):
+        super().__init__(f"fetch partition {partition}: broker error {code}")
+        self.partition = partition
+        self.code = code
 
 
 # ---------------------------------------------------------------------------
@@ -274,7 +284,7 @@ class KafkaClient:
             (n_parts,) = struct.unpack_from(">i", resp, pos)
             pos += 4
             for _ in range(n_parts):
-                (_p, err, _hw, _lso) = struct.unpack_from(">ihqq", resp, pos)
+                (p, err, _hw, _lso) = struct.unpack_from(">ihqq", resp, pos)
                 pos += 22
                 (n_aborted,) = struct.unpack_from(">i", resp, pos)
                 pos += 4
@@ -282,10 +292,41 @@ class KafkaClient:
                     pos += 16 * n_aborted  # producer_id + first_offset
                 (set_len,) = struct.unpack_from(">i", resp, pos)
                 pos += 4
-                if err == 0 and set_len > 0:
+                if err != 0:
+                    # surfaced, never swallowed: OFFSET_OUT_OF_RANGE in
+                    # particular means the tracked offset fell off the
+                    # log and must be re-resolved
+                    raise KafkaFetchError(p, err)
+                if set_len > 0:
                     records.extend(decode_record_batches(resp[pos : pos + set_len]))
                 pos += max(set_len, 0)
         return records
+
+    def earliest_offset(self, topic: str, partition: int) -> int:
+        """ListOffsets v1 with timestamp=-2 (earliest)."""
+        body = (
+            struct.pack(">i", -1)
+            + struct.pack(">i", 1)
+            + _str(topic)
+            + struct.pack(">i", 1)
+            + struct.pack(">iq", partition, -2)
+        )
+        resp = self._roundtrip(API_LIST_OFFSETS, 1, body)
+        pos = 0
+        (n_topics,) = struct.unpack_from(">i", resp, pos)
+        pos += 4
+        for _ in range(n_topics):
+            _name, pos = _read_str(resp, pos)
+            (n_parts,) = struct.unpack_from(">i", resp, pos)
+            pos += 4
+            for _ in range(n_parts):
+                p, err, _ts, off = struct.unpack_from(">ihqq", resp, pos)
+                pos += 22
+                if p == partition:
+                    if err != 0:
+                        raise KafkaFetchError(p, err)
+                    return off
+        raise OSError(f"kafka: no ListOffsets answer for {topic}/{partition}")
 
 
 class KafkaReceiver:
@@ -328,13 +369,32 @@ class KafkaReceiver:
             self._client = KafkaClient(self.brokers[0])
         if not self._offsets:
             # (re)discover partitions: the topic may be auto-created
-            # after this receiver starts
+            # after this receiver starts. Start at the EARLIEST retained
+            # offset (retention may have deleted the log head).
             for p in self._client.partitions(self.topic):
-                self._offsets.setdefault(p, 0)
+                try:
+                    start = self._client.earliest_offset(self.topic, p)
+                except (KafkaFetchError, OSError):
+                    start = 0
+                self._offsets.setdefault(p, start)
         n = 0
         for p, off in list(self._offsets.items()):
             try:
                 records = self._client.fetch(self.topic, p, off)
+            except KafkaFetchError as e:
+                self.errors += 1
+                if e.code == ERR_OFFSET_OUT_OF_RANGE:
+                    # the tracked offset fell off the log: resume from
+                    # the earliest retained offset
+                    try:
+                        self._offsets[p] = self._client.earliest_offset(self.topic, p)
+                        log.warning("kafka partition %d: offset %d out of range, "
+                                    "reset to %d", p, off, self._offsets[p])
+                    except (KafkaFetchError, OSError):
+                        log.exception("kafka partition %d: offset reset failed", p)
+                else:
+                    log.warning("kafka partition %d: broker error %d", p, e.code)
+                continue
             except ValueError:
                 # undecodable batch (compressed/corrupt): count it, step
                 # past one offset so the consumer cannot wedge forever

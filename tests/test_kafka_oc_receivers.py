@@ -85,6 +85,7 @@ class FakeBroker:
         self.topic = topic
         self.logs = {p: [] for p in range(partitions)}  # partition -> [batch bytes]
         self.base = {p: 0 for p in range(partitions)}
+        self.log_start = {p: 0 for p in range(partitions)}  # earliest retained
         self.sock = socket.socket()
         self.sock.bind(("127.0.0.1", 0))
         self.sock.listen(4)
@@ -120,6 +121,8 @@ class FakeBroker:
                     out = self._metadata()
                 elif api == 1:
                     out = self._fetch(body)
+                elif api == 2:
+                    out = self._list_offsets(body)
                 else:
                     return
                 resp = struct.pack(">i", corr) + out
@@ -152,6 +155,25 @@ class FakeBroker:
             out += struct.pack(">ii", 1, 0)  # isr [0]
         return bytes(out)
 
+    def _list_offsets(self, body: bytes) -> bytes:
+        # v1: replica i32 | topics[name, partitions[partition i32, ts i64]]
+        pos = 4 + 4
+        name, pos = _read_str(body, pos)
+        (n_parts,) = struct.unpack_from(">i", body, pos)
+        pos += 4
+        parts = []
+        for _ in range(n_parts):
+            p, ts = struct.unpack_from(">iq", body, pos)
+            pos += 12
+            off = self.log_start.get(p, 0) if ts == -2 else self.base.get(p, 0)
+            parts.append((p, off))
+        out = bytearray(struct.pack(">i", 1))
+        out += _str(self.topic)
+        out += struct.pack(">i", len(parts))
+        for p, off in parts:
+            out += struct.pack(">ihqq", p, 0, -1, off)
+        return bytes(out)
+
     def _fetch(self, body: bytes) -> bytes:
         pos = 4 + 4 + 4 + 4 + 1  # replica, max_wait, min_bytes, max_bytes, isolation
         (n_topics,) = struct.unpack_from(">i", body, pos)
@@ -170,6 +192,11 @@ class FakeBroker:
         out += _str(self.topic)
         out += struct.pack(">i", len(requests))
         for _name, p, off in requests:
+            if off < self.log_start.get(p, 0):
+                out += struct.pack(">ihqq", p, 1, self.base.get(p, 0), self.base.get(p, 0))
+                out += struct.pack(">i", 0)
+                out += struct.pack(">i", 0)
+                continue
             # serve every batch whose base offset >= requested offset
             # (coarse, like a real broker serving whole batches)
             data = b"".join(
@@ -379,3 +406,41 @@ def test_loadtest_short_run():
     summary = _json.loads(out.stdout.strip().splitlines()[-1])
     assert summary["passed"] is True
     assert all(v in ("ok", "skipped") for v in summary["receiver_sweep"].values())
+
+
+class TestKafkaOffsetRecovery:
+    def test_starts_at_earliest_retained_offset(self):
+        broker = FakeBroker(partitions=1)
+        # retention removed offsets [0, 5); log starts at 5
+        broker.base[0] = 5
+        broker.log_start[0] = 5
+        t = make_trace(9)
+        broker.produce(0, [otlp.encode_traces_request([t])])
+        got = []
+        rx = KafkaReceiver(lambda traces, org_id=None: got.extend(traces),
+                           [broker.addr], "traces")
+        assert rx.poll_once() == 1
+        assert got and got[0].trace_id == t.trace_id
+        rx.stop()
+        broker.close()
+
+    def test_offset_out_of_range_resets_to_earliest(self):
+        broker = FakeBroker(partitions=1)
+        t = make_trace(8)
+        broker.produce(0, [otlp.encode_traces_request([t])])
+        got = []
+        rx = KafkaReceiver(lambda traces, org_id=None: got.extend(traces),
+                           [broker.addr], "traces")
+        rx.poll_once()
+        assert len(got) == 1
+        # retention jumps past the tracked offset
+        broker.log_start[0] = 10
+        broker.base[0] = 10
+        t2 = make_trace(7)
+        broker.produce(0, [otlp.encode_traces_request([t2])])
+        rx.poll_once()  # hits OFFSET_OUT_OF_RANGE -> resets to earliest (10)
+        assert rx.errors >= 1
+        rx.poll_once()
+        assert {x.trace_id for x in got} == {t.trace_id, t2.trace_id}
+        rx.stop()
+        broker.close()

@@ -88,3 +88,101 @@ class TestShardedBloomTest:
         assert maybe[3, 0], "true member must always test positive"
         # the stranger should be pruned almost everywhere (fp ~1%)
         assert maybe[:, 1].sum() <= 3
+
+
+class TestMeshSearcherEngine:
+    """Round-2/3 verdict item: the sharded scan must serve the real
+    querier path, not only its own unit tests."""
+
+    def _db(self, n_blocks=10):
+        from tempo_tpu.backend import MockBackend
+        from tempo_tpu.db import DBConfig, TempoDB
+        from tempo_tpu.model import synth
+        from tempo_tpu.model import trace as tr
+
+        db = TempoDB(DBConfig(backend="mock"), raw_backend=MockBackend())
+        svc_traces = []
+        for i in range(n_blocks):
+            traces = synth.make_traces(12, seed=100 + i, spans_per_trace=4)
+            db.write_batch("t", tr.traces_to_batch(traces).sorted_by_trace())
+            svc_traces.extend(traces)
+        return db, svc_traces
+
+    def test_ten_block_search_matches_single_device(self):
+        from tempo_tpu.encoding.common import SearchRequest
+
+        db, traces = self._db(10)
+        assert db.mesh_searcher() is not None, "expected the 8-device test mesh"
+        # pick a service present in the data
+        svc = None
+        for t in traces:
+            svc = t.batches[0][0].get("service.name")
+            if svc:
+                break
+        req = SearchRequest(tags={"service.name": svc}, limit=0)
+        got = db.search("t", req)  # mesh path (>1 block, mesh present)
+
+        # force the single-device per-block path for the same query
+        db._mesh_searcher = False
+        want = db.search("t", req)
+        db._mesh_searcher = None
+        assert {x.trace_id_hex for x in got.traces} == {x.trace_id_hex for x in want.traces}
+        assert got.traces and got.inspected_blocks == 10
+
+    def test_column_cache_hits_across_queries(self):
+        from tempo_tpu.encoding.common import SearchRequest
+
+        db, traces = self._db(6)
+        searcher = db.mesh_searcher()
+        svc = next(t.batches[0][0]["service.name"] for t in traces
+                   if t.batches[0][0].get("service.name"))
+        req = SearchRequest(tags={"service.name": svc}, limit=0)
+        db.search("t", req)
+        misses_after_first = searcher.cache_misses
+        assert misses_after_first > 0 and searcher.cache_hits == 0
+        db.search("t", req)  # hot: same predicate columns
+        assert searcher.cache_misses == misses_after_first
+        assert searcher.cache_hits >= misses_after_first
+
+    def test_attr_and_duration_predicates_on_mesh_path(self):
+        from tempo_tpu.encoding.common import SearchRequest
+
+        db, traces = self._db(4)
+        # service + duration window: device mask AND host-side duration
+        svc = next(t.batches[0][0]["service.name"] for t in traces
+                   if t.batches[0][0].get("service.name"))
+        req = SearchRequest(tags={"service.name": svc}, min_duration_ns=1, limit=0)
+        got = db.search("t", req)
+        db._mesh_searcher = False
+        want = db.search("t", req)
+        db._mesh_searcher = None
+        assert {x.trace_id_hex for x in got.traces} == {x.trace_id_hex for x in want.traces}
+
+    def test_rf_duplicates_deduped_and_sorted(self):
+        """The mesh path must apply SearchResponse.merge's discipline:
+        RF copies of a trace in two blocks collapse to one hit, newest
+        first, limit respected."""
+        from tempo_tpu.backend import MockBackend
+        from tempo_tpu.db import DBConfig, TempoDB
+        from tempo_tpu.encoding.common import SearchRequest
+        from tempo_tpu.model import synth
+        from tempo_tpu.model import trace as tr
+
+        db = TempoDB(DBConfig(backend="mock"), raw_backend=MockBackend())
+        traces = synth.make_traces(20, seed=42, spans_per_trace=3)
+        # RF=2 shape: the same traces land in two blocks
+        db.write_batch("t", tr.traces_to_batch(traces).sorted_by_trace())
+        db.write_batch("t", tr.traces_to_batch(traces).sorted_by_trace())
+        assert db.mesh_searcher() is not None
+        svc = next(t.batches[0][0]["service.name"] for t in traces
+                   if t.batches[0][0].get("service.name"))
+        got = db.search("t", SearchRequest(tags={"service.name": svc}, limit=0))
+        ids = [t.trace_id_hex for t in got.traces]
+        assert len(ids) == len(set(ids)), "duplicate trace in mesh results"
+        starts = [t.start_time_unix_nano for t in got.traces]
+        assert starts == sorted(starts, reverse=True), "not newest-first"
+        # limit truncates AFTER dedupe
+        limited = db.search("t", SearchRequest(tags={"service.name": svc}, limit=3))
+        assert len(limited.traces) <= 3
+        lids = [t.trace_id_hex for t in limited.traces]
+        assert len(lids) == len(set(lids))
