@@ -12,25 +12,26 @@ blocks (the reference's default 2-in/1-out shape,
 tempodb/compactor.go:21-23) with 25% RF-duplicated traces per pair.
 
 Statistical discipline (round-3 lesson: a single noisy sample made a
-byte-identical tree regress 2.2x in the round artifact):
+byte-identical tree regress 2.2x in the round artifact; round-4
+measurement found multi-second host-level noise epochs that hit even
+CPU-only runs on this VM):
 - one untimed warmup pass per arm excludes jit compiles,
-- >= BENCH_REPS timed repetitions per arm; the published value is the
-  MEDIAN, and spread_pct = IQR/median so a noisy run is visible in the
-  artifact instead of silently wrong,
-- 1-minute load average is printed to stderr before/after so host
-  contention (this box has ONE core) is attributable,
-- vs_baseline divides PER-CHIP throughputs on both sides (the
-  accelerator arm is divided by its device count).
+- the accelerator arm and the CPU baseline arms run INTERLEAVED, one
+  rep at a time (the baseline lives in a persistent JAX_PLATFORMS=cpu
+  child process), so a noise epoch degrades all arms equally,
+- vs_baseline is the MEDIAN of PER-REP PAIRED ratios (cpu_dt/tpu_dt) —
+  epoch noise cancels in the pairing,
+- the published value is the median accelerator throughput with
+  spread_pct = IQR/median so a noisy run is visible in the artifact,
+- the workload runs on tmpfs (virtio writeback noise dominated /tmp),
+- 1-minute load average is printed to stderr before/after.
 
-Baseline: the SAME end-to-end pipeline in a CPU-only subprocess
-(JAX_PLATFORMS=cpu) constrained to a single core's worth of work —
-numpy merge plan (np_merge_spans), jax-CPU sketch kernels, serial codec
-(codec.set_threads(1)). A second, stronger single-core CPU
-configuration (native C++ merge) is measured and reported on stderr for
-context. Recall gates: both runs must achieve 100% find-by-ID recall on
+Baseline: the SAME end-to-end pipeline constrained to a single core's
+worth of work — numpy merge plan, jax-CPU sketch kernels, serial codec.
+A second, stronger single-core config (native C++ merge) is reported on
+stderr. Recall gates: all arms must achieve 100% find-by-ID recall on
 traces sampled from BOTH input blocks across ALL row groups, and the
-bloom false-positive rate on absent IDs is checked against the
-configured budget.
+bloom FP rate on absent IDs is checked against the configured budget.
 
 BASELINE.md configs (1) 10k-span ingest->flush->compact, (2) 100-block
 window sweep, and (4) multi-block tag search live in tools/bench_suite.py.
@@ -77,6 +78,16 @@ def _loadavg() -> float:
         return -1.0
 
 
+def _bench_dir() -> str | None:
+    """Prefer tmpfs: the VM's virtio disk writeback adds multi-second
+    run-to-run swings that have nothing to do with the engine (all arms
+    get the same treatment, so ratios stay fair)."""
+    for d in ("/dev/shm", None):
+        if d is None or (os.path.isdir(d) and os.access(d, os.W_OK)):
+            return d
+    return None
+
+
 def build_inputs(backend, cfg):
     """B_BLOCKS input blocks; each odd block RF-duplicates 25% of the
     traces of its pair partner (identical payload -> dedupe fast path,
@@ -97,6 +108,50 @@ def build_inputs(backend, cfg):
         metas.append(enc.create_block([a], "bench", backend, cfg))
         metas.append(enc.create_block([b], "bench", backend, cfg))
     return metas
+
+
+class Arm:
+    """One benchmark configuration: owns its backend + inputs; runs one
+    timed rep on demand; verifies recall at the end."""
+
+    def __init__(self, opts_kw: dict):
+        from tempo_tpu.backend import LocalBackend, TypedBackend
+        from tempo_tpu.encoding.common import BlockConfig, CompactionOptions
+        from tempo_tpu.encoding.vtpu.compactor import VtpuCompactor
+
+        self._tmp = tempfile.TemporaryDirectory(dir=_bench_dir())
+        self.backend = TypedBackend(LocalBackend(self._tmp.name))
+        self.cfg = BlockConfig()
+        self.metas = build_inputs(self.backend, self.cfg)
+        self.opts = CompactionOptions(block_config=self.cfg, **opts_kw)
+        self._Compactor = VtpuCompactor
+        self.jobs = [(self.metas[i], self.metas[i + 1]) for i in range(0, len(self.metas), 2)]
+        self.outs: list = []
+        self._rep = 0
+        # warm the jit caches on a throwaway pair so compile time is
+        # excluded (steady-state throughput, like -benchtime loops)
+        self._Compactor(self.opts).compact(self.metas[:2], "bench-warm", self.backend)
+
+    def one_rep(self) -> float:
+        self._rep += 1
+        self.outs = []
+        t0 = time.perf_counter()
+        for j, pair in enumerate(self.jobs):
+            comp = self._Compactor(self.opts)
+            self.outs.extend(comp.compact(list(pair), f"bench-{self._rep}-{j}", self.backend))
+        return time.perf_counter() - t0
+
+    def finalize(self) -> dict:
+        recall, fp = _check_recall(self.backend, self.cfg, self.jobs, self.outs)
+        return {
+            "recall": recall,
+            "bloom_fp_rate": fp,
+            "bloom_fp_budget": self.cfg.bloom_fp,
+            "output_spans": sum(o.total_spans for o in self.outs),
+        }
+
+    def close(self):
+        self._tmp.cleanup()
 
 
 def _check_recall(backend, cfg, jobs, outs):
@@ -142,83 +197,42 @@ def _check_recall(backend, cfg, jobs, outs):
     return found / max(tested, 1), fp / max(fp_n, 1)
 
 
-def run_engine(backend, cfg, metas, opts_kw) -> dict:
-    """Time compaction of all jobs end-to-end; verify recall on outputs."""
-    from tempo_tpu.encoding.common import CompactionOptions
-    from tempo_tpu.encoding.vtpu.compactor import VtpuCompactor
+def _stats(times: list[float]) -> tuple[float, float]:
+    arr = np.sort(np.asarray(times))
+    med = float(np.median(arr))
+    q1, q3 = np.percentile(arr, [25, 75])
+    return med, (float((q3 - q1) / med) if med else 0.0)
 
-    opts = CompactionOptions(block_config=cfg, **opts_kw)
 
-    # warm the jit caches on a throwaway pair so compile time is excluded
-    # (steady-state throughput, like the reference's -benchtime loops)
-    warm = VtpuCompactor(opts)
-    warm.compact(metas[:2], "bench-warm", backend)
+# ---------------------------------------------------------------------------
+# child: persistent CPU-baseline server, one rep per request so the
+# parent can interleave arms (host noise epochs hit all arms equally)
+# ---------------------------------------------------------------------------
 
-    jobs = [(metas[i], metas[i + 1]) for i in range(0, len(metas), 2)]
-    times = []
-    outs = []
-    for rep in range(REPS):
-        outs = []
-        t0 = time.perf_counter()
-        for j, pair in enumerate(jobs):
-            comp = VtpuCompactor(opts)
-            outs.extend(comp.compact(list(pair), f"bench-{rep}-{j}", backend))
-        times.append(time.perf_counter() - t0)
 
-    times_s = np.sort(np.asarray(times))
-    med = float(np.median(times_s))
-    q1, q3 = np.percentile(times_s, [25, 75])
-    spread = float((q3 - q1) / med) if med else 0.0
+def child_server():
+    _setup_jax()
+    from tempo_tpu.encoding.vtpu import codec as codec_mod
 
-    recall, fp_rate = _check_recall(backend, cfg, jobs, outs)
-    if fp_rate > 2 * cfg.bloom_fp:  # 2x margin for sampling noise
-        print(f"[bench] WARNING: bloom fp rate {fp_rate:.4f} exceeds budget "
-              f"{cfg.bloom_fp}", file=sys.stderr)
-    spans_in = sum(m.total_spans for m in metas)
-    return {
-        "seconds_median": med,
-        "seconds_all": [round(t, 3) for t in times],
-        "spread_pct": round(100 * spread, 1),
-        "blocks_per_s": len(metas) / med,
-        "spans_per_s": spans_in / med,
-        "recall": recall,
-        "bloom_fp_rate": fp_rate,
-        "outputs": len(outs),
-        "output_spans": sum(o.total_spans for o in outs),
+    codec_mod.set_threads(1)
+    arms = {
+        "single": Arm({"merge_path": "numpy"}),
+        "native": Arm({"merge_path": "auto"}),  # C++ merge, same 1-thread caps
     }
-
-
-def _bench_dir() -> str | None:
-    """Prefer tmpfs: the VM's virtio disk writeback adds multi-second
-    run-to-run swings that have nothing to do with the engine (both
-    arms get the same treatment, so the ratio stays fair)."""
-    for d in ("/dev/shm", None):
-        if d is None or (os.path.isdir(d) and os.access(d, os.W_OK)):
-            return d
-    return None
-
-
-def run_local(opts_kw: dict) -> dict:
-    from tempo_tpu.backend import LocalBackend, TypedBackend
-    from tempo_tpu.encoding.common import BlockConfig
-
-    with tempfile.TemporaryDirectory(dir=_bench_dir()) as tmp:
-        backend = TypedBackend(LocalBackend(tmp))
-        cfg = BlockConfig()
-        metas = build_inputs(backend, cfg)
-        return run_engine(backend, cfg, metas, opts_kw)
+    print(json.dumps({"ready": True}), flush=True)
+    for line in sys.stdin:
+        cmd = line.strip()
+        if not cmd:
+            continue
+        if cmd == "finish":
+            print(json.dumps({k: a.finalize() for k, a in arms.items()}), flush=True)
+            break
+        print(json.dumps({"dt": arms[cmd].one_rep()}), flush=True)
 
 
 def main():
-    if "--child-cpu" in sys.argv:
-        _setup_jax()
-        from tempo_tpu.encoding.vtpu import codec as codec_mod
-
-        codec_mod.set_threads(1)
-        single = run_local({"merge_path": "numpy"})
-        native = run_local({"merge_path": "auto"})  # same 1-thread caps,
-        # C++ merge instead of numpy — the strongest single-core CPU config
-        print(json.dumps({"single_core": single, "native_merge": native}))
+    if "--child-server" in sys.argv:
+        child_server()
         return
 
     jax = _setup_jax()
@@ -231,14 +245,9 @@ def main():
     if n_dev > 1:
         from tempo_tpu.parallel.mesh import compaction_mesh
 
-        tpu = run_local({"mesh": compaction_mesh(n_dev)})
+        tpu_arm = Arm({"mesh": compaction_mesh(n_dev)})
     else:
-        tpu = run_local({"merge_path": "auto"})
-    print(f"[bench] {platform} x{n_dev}: {tpu}", file=sys.stderr)
-    if tpu["spread_pct"] > 15:
-        print(f"[bench] WARNING: accelerator arm spread {tpu['spread_pct']}% "
-              f"(IQR/median) — host or tunnel contention; treat the value "
-              f"with suspicion", file=sys.stderr)
+        tpu_arm = Arm({"merge_path": "auto"})
 
     # pin the child to one core's worth of work everywhere: XLA CPU
     # intra-op threads, BLAS pools, and the codec pool (set in-child)
@@ -250,41 +259,80 @@ def main():
         OPENBLAS_NUM_THREADS="1",
         TEMPO_TPU_OVERLAP="0",
     )
-    child = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), "--child-cpu"],
-        capture_output=True, text=True, env=env, timeout=3600,
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child-server"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, stderr=sys.stderr,
+        text=True, bufsize=1, env=env,
     )
-    cpu = None
-    for line in reversed(child.stdout.strip().splitlines()):
+
+    def ask(cmd: str) -> dict:
+        child.stdin.write(cmd + "\n")
+        child.stdin.flush()
+        line = child.stdout.readline()
+        if not line:
+            raise RuntimeError("cpu baseline child died")
+        return json.loads(line)
+
+    tpu_times: list[float] = []
+    single_times: list[float] = []
+    native_times: list[float] = []
+    try:
+        ready = json.loads(child.stdout.readline())
+        assert ready.get("ready"), ready
+        for rep in range(REPS):
+            tpu_times.append(tpu_arm.one_rep())
+            single_times.append(ask("single")["dt"])
+            native_times.append(ask("native")["dt"])
+            print(f"[bench] rep {rep}: tpu {tpu_times[-1]:.2f}s  "
+                  f"single {single_times[-1]:.2f}s  native {native_times[-1]:.2f}s",
+                  file=sys.stderr)
+        cpu_summary = ask("finish")
+    finally:
         try:
-            cpu = json.loads(line)
-            break
-        except json.JSONDecodeError:
-            continue
-    if cpu is None:
-        print(f"[bench] cpu baseline failed: {child.stderr[-2000:]}", file=sys.stderr)
-        vs = 0.0
-    else:
-        print(f"[bench] cpu single-core baseline: {cpu['single_core']}", file=sys.stderr)
-        print(f"[bench] cpu native-merge config:  {cpu['native_merge']}", file=sys.stderr)
-        # per-chip on BOTH sides: the accelerator arm divides by its
-        # device count, the single-core CPU arm is already per-core
-        vs = (tpu["blocks_per_s"] / max(n_dev, 1)) / cpu["single_core"]["blocks_per_s"]
-        vs_native = (tpu["blocks_per_s"] / max(n_dev, 1)) / cpu["native_merge"]["blocks_per_s"]
-        print(f"[bench] vs native-merge single-core: {vs_native:.3f}", file=sys.stderr)
-        if cpu["single_core"]["recall"] < 1.0:
-            print("[bench] WARNING: cpu baseline recall < 1", file=sys.stderr)
-    if tpu["recall"] < 1.0:
-        print("[bench] WARNING: accelerator recall < 1", file=sys.stderr)
+            child.stdin.close()
+            child.wait(timeout=60)
+        except Exception:
+            child.kill()
+
+    tpu_summary = tpu_arm.finalize()
+    tpu_arm.close()
+
+    med, spread = _stats(tpu_times)
+    blocks_per_s = B_BLOCKS / med
+    # paired per-rep ratios: epoch noise hits both arms of a pair, so the
+    # ratio is far more stable than a ratio of independent medians
+    vs_single = float(np.median([c / t for c, t in zip(single_times, tpu_times)]))
+    vs_native = float(np.median([c / t for c, t in zip(native_times, tpu_times)]))
+
+    print(f"[bench] {platform} x{n_dev}: median {med:.2f}s over {REPS} reps "
+          f"(all: {[round(t, 2) for t in tpu_times]}), spread {100*spread:.1f}%",
+          file=sys.stderr)
+    print(f"[bench] cpu single-core reps: {[round(t, 2) for t in single_times]} "
+          f"summary {cpu_summary['single']}", file=sys.stderr)
+    print(f"[bench] cpu native-merge reps: {[round(t, 2) for t in native_times]} "
+          f"summary {cpu_summary['native']}", file=sys.stderr)
+    print(f"[bench] paired vs single-core: {vs_single:.3f}  "
+          f"paired vs native-merge: {vs_native:.3f}", file=sys.stderr)
+    if spread > 0.15:
+        print(f"[bench] WARNING: accelerator arm spread {100*spread:.1f}% "
+              f"(IQR/median) — host or tunnel contention; the paired "
+              f"vs_baseline is noise-resistant, the absolute value less so",
+              file=sys.stderr)
+    for name, summary in (("tpu", tpu_summary), ("single", cpu_summary["single"]),
+                          ("native", cpu_summary["native"])):
+        if summary["recall"] < 1.0:
+            print(f"[bench] WARNING: {name} arm recall {summary['recall']}", file=sys.stderr)
+        if summary["bloom_fp_rate"] > 2 * summary["bloom_fp_budget"]:
+            print(f"[bench] WARNING: {name} arm bloom fp {summary['bloom_fp_rate']}", file=sys.stderr)
     print(f"[bench] loadavg after: {_loadavg():.2f}", file=sys.stderr)
 
     print(json.dumps({
         "metric": "blocks_compacted_per_sec_per_chip",
-        "value": round(tpu["blocks_per_s"] / max(n_dev, 1), 3),
+        "value": round(blocks_per_s / max(n_dev, 1), 3),
         "unit": "blocks/s/chip",
-        "vs_baseline": round(vs, 3),
+        "vs_baseline": round(vs_single / max(n_dev, 1), 3),
         "reps": REPS,
-        "spread_pct": tpu["spread_pct"],
+        "spread_pct": round(100 * spread, 1),
     }))
 
 

@@ -207,13 +207,7 @@ class VtpuBackendBlock:
 
         # attr predicates: evaluate over the attr table then AND per-span
         if span_mask.any() and preds["attr"]:
-            attrs = self.read_columns(rg, ["attr_span", "attr_key", "attr_vtype", "attr_str"])
-            is_str = attrs["attr_vtype"] == VT_STR
-            for key_code, val_codes in preds["attr"]:
-                arow = (attrs["attr_key"] == key_code) & is_str & np.isin(attrs["attr_str"], val_codes)
-                ok_spans = np.zeros(n, bool)
-                ok_spans[attrs["attr_span"][arow]] = True
-                span_mask &= ok_spans
+            span_mask &= attr_predicate_mask(self, rg, preds)
 
         if not span_mask.any():
             return []
@@ -542,6 +536,24 @@ def _string_codes(d, op, val):
     rx = _re.compile(val)
     codes = [i for i, e in enumerate(d.entries) if rx.search(e)]
     return np.asarray(codes, np.uint32) if codes else None
+
+
+def attr_predicate_mask(blk, rg, preds) -> np.ndarray:
+    """AND of the attr-table predicates as a span mask — shared by the
+    single-block scan and the mesh searcher so the two paths cannot
+    drift."""
+    n = rg.n_spans
+    mask = np.ones(n, bool)
+    if not preds["attr"]:
+        return mask
+    attrs = blk.read_columns(rg, ["attr_span", "attr_key", "attr_vtype", "attr_str"])
+    is_str = attrs["attr_vtype"] == VT_STR
+    for key_code, val_codes in preds["attr"]:
+        arow = (attrs["attr_key"] == key_code) & is_str & np.isin(attrs["attr_str"], val_codes)
+        ok_spans = np.zeros(n, bool)
+        ok_spans[attrs["attr_span"][arow]] = True
+        mask &= ok_spans
+    return mask
 
 
 def _resolve_tag_predicates(req: SearchRequest, d):

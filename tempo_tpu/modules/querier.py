@@ -108,8 +108,13 @@ class Querier:
         scan serially like the reference's per-job loop."""
         searcher = self.db.mesh_searcher() if not self.external_endpoints else None
         if searcher is not None and len(block_ids) > 1:
-            metas = [self.db.backend.block_meta(tenant, bid) for bid in block_ids]
-            if all(m.version == "vtpu1" for m in metas):
+            metas = []
+            for bid in block_ids:
+                try:
+                    metas.append(self.db.backend.block_meta(tenant, bid))
+                except Exception:
+                    log.warning("search job: block %s meta unreadable (deleted?)", bid)
+            if metas and all(m.version == "vtpu1" for m in metas):
                 blocks = (
                     self.db.encoding_for(m.version).open_block(m, self.db.backend, self.db.cfg.block)
                     for m in metas

@@ -190,6 +190,12 @@ class MeshSearcher:
             self.cache_misses += 1
         col = blk.read_columns(rg, [name])[name].astype(np.uint32, copy=False)
         with self._cache_lock:
+            # two threads can race the same miss: replace-don't-double-count
+            # (an unconditional += would ratchet _cache_bytes upward and
+            # shrink the effective capacity toward zero)
+            prev = self._cache.get(key)
+            if prev is not None:
+                self._cache_bytes -= prev.nbytes
             self._cache[key] = col
             self._cache_bytes += col.nbytes
             while self._cache_bytes > self.max_cache_bytes and self._cache:
@@ -213,19 +219,51 @@ class MeshSearcher:
         predicates; duration/attr predicates AND in host-side on matched
         shards only. Results get the same dedupe / newest-first /
         limit discipline as SearchResponse.merge."""
+        import logging
+
         from tempo_tpu.encoding.common import SearchResponse
         from tempo_tpu.encoding.vtpu.block import _resolve_tag_predicates
 
+        log = logging.getLogger(__name__)
         resp = SearchResponse()
         opened: list = []
         hits: list = []
         seen_ids: set = set()
+        errors: list = []
         cap = self.w * self.r
         pending: list = []  # (blk, rg_index, rg, preds)
         done = False
 
         def unique_hits() -> int:
             return len(seen_ids)
+
+        def collect(blk, i, rg, preds, span_mask):
+            nonlocal done
+            # feed the cached predicate columns back so hits_for_mask does
+            # not re-read pages the device scan already pulled
+            have = {
+                name: self._col(blk, i, rg, name)
+                for name, _ in preds["span_eq"]
+            }
+            if preds["attr"]:
+                from tempo_tpu.encoding.vtpu.block import attr_predicate_mask
+
+                span_mask = span_mask & attr_predicate_mask(blk, rg, preds)
+            if req.min_duration_ns or req.max_duration_ns:
+                dur = blk.read_columns(rg, ["duration_nano"])["duration_nano"]
+                have["duration_nano"] = dur
+                if req.min_duration_ns:
+                    span_mask = span_mask & (dur >= np.uint64(req.min_duration_ns))
+                if req.max_duration_ns:
+                    span_mask = span_mask & (dur <= np.uint64(req.max_duration_ns))
+            if not span_mask.any():
+                return
+            for h in blk.hits_for_mask(rg, span_mask, req, 0, have_cols=have):
+                if h.trace_id_hex not in seen_ids:
+                    seen_ids.add(h.trace_id_hex)
+                    hits.append(h)
+            if req.limit and unique_hits() >= req.limit:
+                done = True
 
         def flush(chunk):
             nonlocal done
@@ -236,10 +274,14 @@ class MeshSearcher:
                 # no device-scannable predicate: plain per-row-group scan
                 for blk, i, rg, preds in chunk:
                     resp.inspected_traces += rg.n_traces
-                    for h in blk._search_row_group(rg, req, preds, limit=0):
-                        if h.trace_id_hex not in seen_ids:
-                            seen_ids.add(h.trace_id_hex)
-                            hits.append(h)
+                    try:
+                        for h in blk._search_row_group(rg, req, preds, limit=0):
+                            if h.trace_id_hex not in seen_ids:
+                                seen_ids.add(h.trace_id_hex)
+                                hits.append(h)
+                    except Exception as e:  # partial failure: skip the unit
+                        errors.append(e)
+                        log.warning("mesh search: row group scan failed: %s", e)
                     if req.limit and unique_hits() >= req.limit:
                         done = True
                         return
@@ -249,35 +291,40 @@ class MeshSearcher:
             cols = np.zeros((cap, n_cols, pad), np.uint32)
             codes = np.full((cap, n_cols, self.max_codes), NO_MATCH, np.uint32)
             valid = np.zeros((cap, pad), bool)
+            live = []
             for s, (blk, i, rg, preds) in enumerate(chunk):
-                for c, (col_name, accept) in enumerate(preds["span_eq"]):
-                    cols[s, c, : rg.n_spans] = self._col(blk, i, rg, col_name)
-                    k = min(len(accept), self.max_codes)
-                    codes[s, c, :k] = accept[:k]
+                try:
+                    for c, (col_name, accept) in enumerate(preds["span_eq"]):
+                        cols[s, c, : rg.n_spans] = self._col(blk, i, rg, col_name)
+                        k = min(len(accept), self.max_codes)
+                        codes[s, c, :k] = accept[:k]
+                except Exception as e:  # e.g. block deleted mid-query
+                    errors.append(e)
+                    log.warning("mesh search: column load failed: %s", e)
+                    continue
                 for c in range(len(preds["span_eq"]), n_cols):
                     # unit has fewer predicates than the widest: accept-all
                     codes[s, c, 0] = 0
                 valid[s, : rg.n_spans] = True
+                live.append(s)
             masks, _totals = scan(
                 jnp.asarray(cols.reshape(self.w, self.r, n_cols, pad)),
                 jnp.asarray(codes.reshape(self.w, self.r, n_cols, self.max_codes)),
                 jnp.asarray(valid.reshape(self.w, self.r, pad)),
             )
             masks_np = np.asarray(masks).reshape(cap, pad)
-            for s, (blk, i, rg, preds) in enumerate(chunk):
+            for s in live:
+                blk, i, rg, preds = chunk[s]
                 resp.inspected_traces += rg.n_traces
                 span_mask = masks_np[s, : rg.n_spans].copy()
                 if not span_mask.any():
                     continue
-                span_mask &= self._host_predicates(blk, rg, req, preds)
-                if not span_mask.any():
-                    continue
-                for h in blk.hits_for_mask(rg, span_mask, req, 0):
-                    if h.trace_id_hex not in seen_ids:
-                        seen_ids.add(h.trace_id_hex)
-                        hits.append(h)
-                if req.limit and unique_hits() >= req.limit:
-                    done = True
+                try:
+                    collect(blk, i, rg, preds, span_mask)
+                except Exception as e:
+                    errors.append(e)
+                    log.warning("mesh search: hit collection failed: %s", e)
+                if done:
                     return
 
         for blk in blocks:
@@ -285,10 +332,19 @@ class MeshSearcher:
                 break
             opened.append(blk)
             resp.inspected_blocks += 1
-            preds = _resolve_tag_predicates(req, blk.dictionary())
-            if preds is None:
-                continue  # impossible in this block: no more IO for it
-            for i, rg in enumerate(blk.index().row_groups):
+            try:
+                preds = _resolve_tag_predicates(req, blk.dictionary())
+                if preds is None:
+                    continue  # impossible in this block: no more IO for it
+                row_groups = list(blk.index().row_groups)
+            except Exception as e:
+                # a block deleted between the blocklist snapshot and the
+                # read must not abort the whole tenant search (the
+                # per-block path tolerates exactly this)
+                errors.append(e)
+                log.warning("mesh search: block %s unreadable: %s", blk.meta.block_id, e)
+                continue
+            for i, rg in enumerate(row_groups):
                 if req.start_seconds and rg.end_s < req.start_seconds:
                     continue
                 if req.end_seconds and rg.start_s > req.end_seconds:
@@ -302,6 +358,11 @@ class MeshSearcher:
         if not done:
             flush(pending)
 
+        if errors and not hits and resp.inspected_traces == 0:
+            # nothing succeeded at all: surface the failure (mirrors the
+            # pool path's "raise only when there are no results")
+            raise errors[0]
+
         # same result discipline as SearchResponse.merge: newest first,
         # truncated to the limit (dedupe already applied via seen_ids)
         hits.sort(key=lambda t: -t.start_time_unix_nano)
@@ -310,29 +371,6 @@ class MeshSearcher:
         # cost no IO and are deliberately not counted)
         resp.inspected_bytes = sum(b.bytes_read for b in opened)
         return resp
-
-    @staticmethod
-    def _host_predicates(blk, rg, req, preds) -> np.ndarray:
-        """Duration + attr predicates the device scan does not cover."""
-        n = rg.n_spans
-        mask = np.ones(n, bool)
-        if req.min_duration_ns or req.max_duration_ns:
-            dur = blk.read_columns(rg, ["duration_nano"])["duration_nano"]
-            if req.min_duration_ns:
-                mask &= dur >= np.uint64(req.min_duration_ns)
-            if req.max_duration_ns:
-                mask &= dur <= np.uint64(req.max_duration_ns)
-        if preds["attr"]:
-            from tempo_tpu.model.columnar import VT_STR
-
-            attrs = blk.read_columns(rg, ["attr_span", "attr_key", "attr_vtype", "attr_str"])
-            is_str = attrs["attr_vtype"] == VT_STR
-            for key_code, val_codes in preds["attr"]:
-                arow = (attrs["attr_key"] == key_code) & is_str & np.isin(attrs["attr_str"], val_codes)
-                ok = np.zeros(n, bool)
-                ok[attrs["attr_span"][arow]] = True
-                mask &= ok
-        return mask
 
 
 NO_MATCH = np.uint32(0xFFFFFFFF)
