@@ -227,6 +227,7 @@ class _Handler(BaseHTTPRequestHandler):
         if method == "POST" and path in (
             receivers.OTLP_HTTP_PATH,
             receivers.ZIPKIN_PATH,
+            receivers.ZIPKIN_V1_PATH,
             receivers.JAEGER_THRIFT_PATH,
         ):
             ct = self.headers.get("Content-Type", "")

@@ -23,6 +23,7 @@ from tempo_tpu.receivers import jaeger, otlp, zipkin
 # paths, mirroring the default receiver endpoints
 OTLP_HTTP_PATH = "/v1/traces"
 ZIPKIN_PATH = "/api/v2/spans"
+ZIPKIN_V1_PATH = "/api/v1/spans"  # legacy thrift carrier
 JAEGER_THRIFT_PATH = "/api/traces"
 
 
@@ -50,7 +51,13 @@ def decode_http(path: str, content_type: str, body: bytes) -> list[Trace]:
             return otlp.decode_traces_json(json.loads(body or b"{}"))
         return otlp.decode_traces_request(body)
     if path == ZIPKIN_PATH:
+        if ct in ("application/x-thrift", "application/vnd.apache.thrift.binary"):
+            return zipkin.decode_spans_thrift(body)
         return zipkin.decode_spans_json(json.loads(body or b"[]"))
+    if path == ZIPKIN_V1_PATH:
+        if ct in ("application/x-thrift", "application/vnd.apache.thrift.binary"):
+            return zipkin.decode_spans_thrift(body)
+        raise UnsupportedPayload("zipkin v1 supports only the thrift carrier here")
     if path == JAEGER_THRIFT_PATH:
         return jaeger.decode_batch(body)
     raise UnsupportedPayload(f"no receiver for path {path!r}")

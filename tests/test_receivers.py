@@ -301,3 +301,118 @@ class TestShim:
         assert receivers.decompress_body(gzip.compress(raw), "gzip") == raw
         with pytest.raises(receivers.UnsupportedPayload):
             receivers.decompress_body(raw, "br")
+
+
+# --- zipkin v1 thrift ------------------------------------------------------
+
+
+def _zk_endpoint(service):
+    out = bytearray()
+    _ti32(out, 1, 0)
+    out += struct.pack(">bhh", 6, 2, 0)  # port i16
+    _tstr(out, 3, service)
+    out.append(jaeger.T_STOP)
+    return bytes(out)
+
+
+def _zk_annotation(value, service):
+    out = bytearray()
+    _ti64(out, 1, 1)  # timestamp
+    _tstr(out, 2, value)
+    out += struct.pack(">bh", jaeger.T_STRUCT, 3) + _zk_endpoint(service)
+    out.append(jaeger.T_STOP)
+    return bytes(out)
+
+
+def _zk_binary_annotation(key, value, service=None):
+    out = bytearray()
+    _tstr(out, 1, key)
+    _tstr(out, 2, value)
+    _ti32(out, 3, 6)  # STRING
+    if service:
+        out += struct.pack(">bh", jaeger.T_STRUCT, 4) + _zk_endpoint(service)
+    out.append(jaeger.T_STOP)
+    return bytes(out)
+
+
+def _signed64(v):
+    return v - (1 << 64) if v >= 1 << 63 else v
+
+
+def _zk_span(tid_hi, tid_lo, sid, pid, name, ts_us, dur_us, annos=(), bannos=()):
+    tid_hi, tid_lo, sid, pid = (_signed64(x) for x in (tid_hi, tid_lo, sid, pid))
+    out = bytearray()
+    _ti64(out, 1, tid_lo)
+    _tstr(out, 3, name)
+    _ti64(out, 4, sid)
+    if pid:
+        _ti64(out, 5, pid)
+    if annos:
+        out += struct.pack(">bh", jaeger.T_LIST, 6)
+        out += struct.pack(">bi", jaeger.T_STRUCT, len(annos))
+        for a in annos:
+            out += a
+    if bannos:
+        out += struct.pack(">bh", jaeger.T_LIST, 8)
+        out += struct.pack(">bi", jaeger.T_STRUCT, len(bannos))
+        for b in bannos:
+            out += b
+    _ti64(out, 10, ts_us)
+    _ti64(out, 11, dur_us)
+    _ti64(out, 12, tid_hi)
+    out.append(jaeger.T_STOP)
+    return bytes(out)
+
+
+class TestZipkinThrift:
+    def _payload(self, spans):
+        out = bytearray()
+        out += struct.pack(">bi", jaeger.T_STRUCT, len(spans))
+        for s in spans:
+            out += s
+        return bytes(out)
+
+    def test_decode_v1_thrift(self):
+        spans = [
+            _zk_span(0x1122334455667788, 0x99AABBCCDDEEFF00, 0x1, 0, "root",
+                     1_700_000_000_000_000, 5000,
+                     annos=[_zk_annotation("sr", "web")],
+                     bannos=[_zk_binary_annotation("http.path", "/x")]),
+            _zk_span(0x1122334455667788, 0x99AABBCCDDEEFF00, 0x2, 0x1, "call",
+                     1_700_000_000_000_100, 300,
+                     annos=[_zk_annotation("cs", "web")]),
+        ]
+        (trace,) = zipkin.decode_spans_thrift(self._payload(spans))
+        assert trace.trace_id == bytes.fromhex("112233445566778899aabbccddeeff00")
+        by_name = {s.name: s for s in trace.all_spans()}
+        root, call = by_name["root"], by_name["call"]
+        from tempo_tpu.model.trace import KIND_CLIENT, KIND_SERVER
+
+        assert root.kind == KIND_SERVER and call.kind == KIND_CLIENT
+        assert root.start_unix_nano == 1_700_000_000_000_000_000
+        assert root.duration_nano == 5_000_000
+        assert root.attributes == {"http.path": "/x"}
+        assert call.parent_span_id == (0x1).to_bytes(8, "big")
+        assert trace.batches[0][0]["service.name"] == "web"
+
+    def test_http_route_v1_and_v2_paths(self):
+        from tempo_tpu import receivers as rx
+
+        spans = [_zk_span(0, 0x42, 0x7, 0, "op", 10, 5,
+                          annos=[_zk_annotation("ss", "svc")])]
+        body = self._payload(spans)
+        for path in (rx.ZIPKIN_V1_PATH, rx.ZIPKIN_PATH):
+            traces = rx.decode_http(path, "application/x-thrift", body)
+            assert traces and traces[0].trace_id.endswith(b"\x42")
+
+    def test_v1_json_rejected(self):
+        from tempo_tpu import receivers as rx
+
+        with pytest.raises(rx.UnsupportedPayload):
+            rx.decode_http(rx.ZIPKIN_V1_PATH, "application/json", b"[]")
+
+    def test_truncated_thrift_rejected(self):
+        spans = [_zk_span(0, 1, 2, 0, "op", 10, 5)]
+        body = self._payload(spans)[:-4]
+        with pytest.raises(Exception):
+            zipkin.decode_spans_thrift(body)
