@@ -360,6 +360,7 @@ class _Handler(BaseHTTPRequestHandler):
 _ENDPOINTS = [
     "POST /v1/traces",
     "POST /api/v2/spans",
+    "POST /api/v1/spans",
     "POST /api/traces",
     "GET /api/traces/{traceID}",
     "GET /api/search",

@@ -66,6 +66,7 @@ def decode_http(path: str, content_type: str, body: bytes) -> list[Trace]:
 __all__ = [
     "OTLP_HTTP_PATH",
     "ZIPKIN_PATH",
+    "ZIPKIN_V1_PATH",
     "JAEGER_THRIFT_PATH",
     "UnsupportedPayload",
     "decode_http",

@@ -109,7 +109,9 @@ def decode_spans_thrift(body: bytes) -> list[Trace]:
                             r.skip(bft)
                     if b_key:
                         tags[b_key] = b_val.decode("utf-8", "replace") if b_type == 6 else b_val.hex()
-                    if b_svc and not service:
+                    # sa/ca describe the REMOTE endpoint — never the
+                    # reporting service (zipkincore semantics)
+                    if b_svc and not service and b_key not in ("sa", "ca"):
                         service = b_svc
             elif fid == 10 and ft == th.T_I64:
                 ts_us = r.i64()
@@ -121,33 +123,24 @@ def decode_spans_thrift(body: bytes) -> list[Trace]:
                 r.skip(ft)
         raw_spans.append((tid_hi, tid_lo, sid, pid, name, ts_us, dur_us, kind, service, tags))
 
-    per_trace: dict[bytes, dict[str, tuple[dict, list]]] = {}
-    for tid_hi, tid_lo, sid, pid, name, ts_us, dur_us, kind, service, tags in raw_spans:
-        tid = (tid_hi & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "big") + (
-            tid_lo & 0xFFFFFFFFFFFFFFFF
-        ).to_bytes(8, "big")
-        status = STATUS_ERROR if "error" in tags else 0
-        span = Span(
-            trace_id=tid,
-            span_id=(sid & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "big"),
-            parent_span_id=(pid & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "big"),
-            name=name,
-            start_unix_nano=ts_us * 1000,
-            duration_nano=dur_us * 1000,
-            kind=kind,
-            status_code=status,
-            attributes=tags,
-        )
-        buckets = per_trace.setdefault(tid, {})
-        if service not in buckets:
-            buckets[service] = ({"service.name": service}, [])
-        buckets[service][1].append(span)
-    out = []
-    for tid, buckets in per_trace.items():
-        t = Trace(trace_id=tid)
-        t.batches = list(buckets.values())
-        out.append(t)
-    return out
+    def gen():
+        for tid_hi, tid_lo, sid, pid, name, ts_us, dur_us, kind, service, tags in raw_spans:
+            tid = (tid_hi & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "big") + (
+                tid_lo & 0xFFFFFFFFFFFFFFFF
+            ).to_bytes(8, "big")
+            yield tid, service, Span(
+                trace_id=tid,
+                span_id=(sid & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "big"),
+                parent_span_id=(pid & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "big"),
+                name=name,
+                start_unix_nano=ts_us * 1000,
+                duration_nano=dur_us * 1000,
+                kind=kind,
+                status_code=STATUS_ERROR if "error" in tags else 0,
+                attributes=tags,
+            )
+
+    return _bucket_by_trace(gen())
 
 
 def _thrift_endpoint_service(r, th) -> str:
@@ -162,23 +155,32 @@ def _thrift_endpoint_service(r, th) -> str:
 
 
 def decode_spans_json(spans: list) -> list[Trace]:
+    def gen():
+        for z in spans or []:
+            tid = _id_bytes(z.get("traceId", ""), 16)
+            service = ((z.get("localEndpoint") or {}).get("serviceName")) or ""
+            tags = {k: str(v) for k, v in (z.get("tags") or {}).items()}
+            yield tid, service, Span(
+                trace_id=tid,
+                span_id=_id_bytes(z.get("id", ""), 8),
+                parent_span_id=_id_bytes(z.get("parentId", ""), 8),
+                name=z.get("name", ""),
+                start_unix_nano=int(z.get("timestamp", 0)) * 1000,
+                duration_nano=int(z.get("duration", 0)) * 1000,
+                kind=_KINDS.get(z.get("kind", ""), 0),
+                status_code=STATUS_ERROR if "error" in tags else 0,
+                attributes=tags,
+            )
+
+    return _bucket_by_trace(gen())
+
+
+def _bucket_by_trace(items) -> list[Trace]:
+    """(trace_id, service, Span) stream -> Traces with per-service
+    resource batches — shared by both zipkin carriers so the bucketing
+    cannot drift between them."""
     per_trace: dict[bytes, dict[str, tuple[dict, list]]] = {}
-    for z in spans or []:
-        tid = _id_bytes(z.get("traceId", ""), 16)
-        service = ((z.get("localEndpoint") or {}).get("serviceName")) or ""
-        tags = {k: str(v) for k, v in (z.get("tags") or {}).items()}
-        status = STATUS_ERROR if "error" in tags else 0
-        span = Span(
-            trace_id=tid,
-            span_id=_id_bytes(z.get("id", ""), 8),
-            parent_span_id=_id_bytes(z.get("parentId", ""), 8),
-            name=z.get("name", ""),
-            start_unix_nano=int(z.get("timestamp", 0)) * 1000,
-            duration_nano=int(z.get("duration", 0)) * 1000,
-            kind=_KINDS.get(z.get("kind", ""), 0),
-            status_code=status,
-            attributes=tags,
-        )
+    for tid, service, span in items:
         buckets = per_trace.setdefault(tid, {})
         if service not in buckets:
             buckets[service] = ({"service.name": service}, [])

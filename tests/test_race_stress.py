@@ -43,22 +43,22 @@ def run_threads(n, fn, seeds):
         t.start()
     for t in threads:
         t.join(timeout=120)
+    stuck = [t.name for t in threads if t.is_alive()]
+    assert not stuck, f"deadlocked workers still alive: {stuck}"
     if errors:
         seed, e = errors[0]
         raise AssertionError(f"seed {seed} raised {type(e).__name__}: {e}") from e
 
 
 class TestIngesterStress:
-    def test_concurrent_push_cut_flush_search(self):
+    def test_concurrent_push_cut_flush_search(self, tmp_path):
         """Pushes, cuts, completes, flushes, and searches interleave on
         one app; every pushed trace must be findable afterwards."""
-        import tempfile
-
         from tempo_tpu.app import App, AppConfig
         from tempo_tpu.db import DBConfig
         from tempo_tpu.model import synth
 
-        tmp = tempfile.mkdtemp()
+        tmp = str(tmp_path)
         app = App(AppConfig(db=DBConfig(backend="local", backend_path=f"{tmp}/b",
                                         wal_path=f"{tmp}/w")))
         pushed: list = []
@@ -83,27 +83,27 @@ class TestIngesterStress:
                 else:
                     app.db.poll_now()
 
-        run_threads(4, worker, seeds=[11, 22, 33, 44])
-        # final settle: cut + flush everything, then every trace is findable
-        app.sweep_all(immediate=True)
-        app.db.poll_now()
-        missing = [tid.hex() for tid in pushed if app.find_trace(tid) is None]
-        assert not missing, f"{len(missing)} pushed traces unfindable: {missing[:3]}"
-        app.shutdown()
+        try:
+            run_threads(4, worker, seeds=[11, 22, 33, 44])
+            # final settle: cut + flush everything -> all traces findable
+            app.sweep_all(immediate=True)
+            app.db.poll_now()
+            missing = [tid.hex() for tid in pushed if app.find_trace(tid) is None]
+            assert not missing, f"{len(missing)} pushed traces unfindable: {missing[:3]}"
+        finally:
+            app.shutdown()
 
 
 class TestKVStress:
-    def test_concurrent_cas_and_watch(self):
+    def test_concurrent_cas_and_watch(self, tmp_path):
         """Counters incremented from racing threads over the HTTP KV land
         exactly once each (CAS discipline), with watchers running."""
-        import tempfile
-
         from tempo_tpu.api.server import TempoServer
         from tempo_tpu.app import App, AppConfig
         from tempo_tpu.db import DBConfig
         from tempo_tpu.modules.netkv import HttpKV
 
-        tmp = tempfile.mkdtemp()
+        tmp = str(tmp_path)
         app = App(AppConfig(db=DBConfig(backend="local", backend_path=f"{tmp}/b",
                                         wal_path=f"{tmp}/w")))
         srv = TempoServer(app).start()
@@ -118,13 +118,15 @@ class TestKVStress:
                 if rng.random() < 0.3:
                     kv.get()
 
-        run_threads(4, worker, seeds=[0, 1, 2, 3])
-        final = clients[1].update(lambda d: d)  # read-through latest
-        assert all(final[f"c{s}"] == 15 for s in range(4)), final
-        for c in clients:
-            c.close()
-        srv.stop()
-        app.shutdown()
+        try:
+            run_threads(4, worker, seeds=[0, 1, 2, 3])
+            final = clients[1].update(lambda d: d)  # read-through latest
+            assert all(final[f"c{s}"] == 15 for s in range(4)), final
+        finally:
+            for c in clients:
+                c.close()
+            srv.stop()
+            app.shutdown()
 
 
 class TestMeshSearcherStress:
