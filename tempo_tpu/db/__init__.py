@@ -13,6 +13,7 @@ their own loops.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -32,6 +33,8 @@ from tempo_tpu.encoding.common import (
 )
 from tempo_tpu.model.trace import Trace, combine_traces
 from tempo_tpu.util import tracing
+
+log = logging.getLogger(__name__)
 
 
 @dataclass
@@ -108,6 +111,11 @@ class TempoDB:
         self.last_poll = 0.0
         self._wal = None
         self._compaction_mesh = False  # False = not yet resolved
+        # per-block tag enumeration memo (blocks are immutable)
+        from collections import OrderedDict
+
+        self._tag_cache: OrderedDict = OrderedDict()
+        self._tag_cache_lock = threading.Lock()
 
     @property
     def wal(self):
@@ -264,6 +272,63 @@ class TempoDB:
             raise errors[0]
         for r in results:
             out.merge(r, limit=req.limit)
+        return out
+
+    def search_tags(self, tenant: str) -> set:
+        """Tag names across this tenant's blocks (parity-plus: the
+        reference snapshot's SearchTags covers only ingester data)."""
+        return self._tag_fanout(tenant, "tag_names")
+
+    def search_tag_values(self, tenant: str, tag: str) -> set:
+        return self._tag_fanout(tenant, "tag_values", tag)
+
+    def _tag_fanout(self, tenant: str, method: str, *args) -> set:
+        """Per-block tag enumeration with a per-block memo (blocks are
+        immutable, and UIs poll these endpoints on every explore load —
+        without the memo each request re-reads every block's index,
+        dictionary, and tag columns from the backend)."""
+        jobs = []
+        for m in self.blocklist.metas(tenant):
+            key = (str(m.block_id), method, args)
+
+            def job(meta=m, key=key):
+                with self._tag_cache_lock:
+                    hit = self._tag_cache.get(key)
+                    if hit is not None:
+                        self._tag_cache.move_to_end(key)
+                        return hit
+                blk = self.encoding_for(meta.version).open_block(meta, self.backend, self.cfg.block)
+                if hasattr(blk, method):
+                    vals = set(getattr(blk, method)(*args))
+                else:
+                    # encodings without native tag enumeration (vrow1):
+                    # derive from the streamed trace batches
+                    from tempo_tpu.model.tags import batch_tag_names, batch_tag_values
+
+                    vals = set()
+                    for batch in blk.iter_trace_batches():
+                        if method == "tag_names":
+                            vals |= batch_tag_names(batch)
+                        else:
+                            vals |= batch_tag_values(batch, *args)
+                with self._tag_cache_lock:
+                    self._tag_cache[key] = vals
+                    while len(self._tag_cache) > 2048:
+                        self._tag_cache.popitem(last=False)
+                return vals
+
+            jobs.append(job)
+        results, errors = self.pool.run_jobs(jobs)
+        if errors and not results:
+            raise errors[0]
+        for e in errors:
+            # partial failure must not poison the union, but it must be
+            # visible — an incomplete tag dropdown with zero signal is
+            # how operators chase ghosts
+            log.warning("tag enumeration skipped a block: %s", e)
+        out: set = set()
+        for vals in results:
+            out |= vals
         return out
 
     def search_block(self, tenant: str, block_id: str, req: SearchRequest,

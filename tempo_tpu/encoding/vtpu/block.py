@@ -353,6 +353,41 @@ class VtpuBackendBlock:
             )
             yield vector.ColumnView(cols, attrs, rg.n_spans), d
 
+    def tag_names(self) -> set:
+        """Tag names present anywhere in this block: well-known columns
+        + attr keys, per row group (reference parity-plus: the snapshot
+        serves tags from ingesters only; Tempo v2 added block-backed
+        SearchTags, which this provides)."""
+        from tempo_tpu.model.tags import WELL_KNOWN_TAGS, tag_names_from_columns
+
+        d = self.dictionary()
+        out: set = set()
+        wk_cols = sorted({col for col, _ in WELL_KNOWN_TAGS.values()})
+        for rg in self.index().row_groups:
+            cols = self.read_columns(rg, wk_cols)
+            attrs = self.read_columns(rg, ["attr_key"])
+            out |= tag_names_from_columns(cols, attrs, d)
+        return out
+
+    def tag_values(self, tag: str) -> set:
+        """Values of one tag across the block's row groups."""
+        from tempo_tpu.model.tags import WELL_KNOWN_TAGS, tag_values_from_columns
+
+        d = self.dictionary()
+        out: set = set()
+        wk = WELL_KNOWN_TAGS.get(tag)
+        if wk is None and d.get(tag) is None:
+            return out  # key not interned: nothing to scan
+        for rg in self.index().row_groups:
+            if wk is not None:
+                cols = self.read_columns(rg, [wk[0]])
+                attrs: dict = {}
+            else:
+                cols = {}
+                attrs = self.read_columns(rg, ["attr_key", "attr_vtype", "attr_str", "attr_num"])
+            out |= tag_values_from_columns(cols, attrs, d, tag)
+        return out
+
     def collect_spans_for_ids(self, hex_ids: set) -> list:
         """All spans of the given trace IDs present in this block.
 

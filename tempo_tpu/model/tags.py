@@ -25,16 +25,21 @@ WELL_KNOWN_TAGS = {
 
 
 def batch_tag_names(batch: SpanBatch) -> set[str]:
+    return tag_names_from_columns(batch.cols, batch.attrs, batch.dictionary)
+
+
+def tag_names_from_columns(cols: dict, attrs: dict, d) -> set[str]:
+    """Column-dict form shared by live batches and backend row groups."""
     out: set[str] = set()
-    d = batch.dictionary
     for tag, (col, kind) in WELL_KNOWN_TAGS.items():
-        vals = batch.cols[col]
+        vals = cols[col]
         if kind == "dict":
             if any(d[int(c)] != "" for c in np.unique(vals)):
                 out.add(tag)
         elif np.any(vals != 0):
             out.add(tag)
-    for code in np.unique(batch.attrs["attr_key"]) if batch.num_attrs else []:
+    keys = attrs.get("attr_key")
+    for code in np.unique(keys) if keys is not None and len(keys) else []:
         name = d[int(code)]
         if name:
             out.add(name)
@@ -42,12 +47,15 @@ def batch_tag_names(batch: SpanBatch) -> set[str]:
 
 
 def batch_tag_values(batch: SpanBatch, tag: str) -> set[str]:
-    d = batch.dictionary
+    return tag_values_from_columns(batch.cols, batch.attrs, batch.dictionary, tag)
+
+
+def tag_values_from_columns(cols: dict, attrs: dict, d, tag: str) -> set[str]:
     out: set[str] = set()
     wk = WELL_KNOWN_TAGS.get(tag)
     if wk is not None:
         col, kind = wk
-        for c in np.unique(batch.cols[col]):
+        for c in np.unique(cols[col]):
             if kind == "dict":
                 s = d[int(c)]
                 if s:
@@ -56,12 +64,12 @@ def batch_tag_values(batch: SpanBatch, tag: str) -> set[str]:
                 out.add(str(int(c)))
         return out
     code = d.get(tag)
-    if code is None or not batch.num_attrs:
+    if code is None or attrs.get("attr_key") is None or not len(attrs["attr_key"]):
         return out
-    mask = batch.attrs["attr_key"] == code
-    vts = batch.attrs["attr_vtype"][mask]
-    strs = batch.attrs["attr_str"][mask]
-    nums = batch.attrs["attr_num"][mask]
+    mask = attrs["attr_key"] == code
+    vts = attrs["attr_vtype"][mask]
+    strs = attrs["attr_str"][mask]
+    nums = attrs["attr_num"][mask]
     for vt, sc, num in zip(vts, strs, nums):
         if vt == VT_STR:
             s = d[int(sc)]

@@ -152,14 +152,20 @@ class Querier:
         return SearchResponse.from_dict(json.loads(body))
 
     def search_tags(self, tenant: str) -> list[str]:
-        """Tag names in not-yet-flushed ingester data (reference:
-        SearchTags fans to ingesters only in this snapshot,
-        modules/querier/querier.go + instance_search.go)."""
+        """Tag names in live ingester data AND backend blocks. The
+        reference snapshot fans SearchTags to ingesters only
+        (modules/querier/querier.go + instance_search.go), so flushed
+        tags vanish from the endpoint; Tempo v2 fixed that with
+        block-backed lookup, which this provides."""
         from tempo_tpu.model.tags import batch_tag_names
 
         out: set[str] = set()
         for batch in self._live_batches(tenant):
             out |= batch_tag_names(batch)
+        try:
+            out |= self.db.search_tags(tenant)
+        except Exception:
+            log.exception("block tag lookup failed; serving live tags only")
         return sorted(out)
 
     def search_tag_values(self, tenant: str, tag: str) -> list[str]:
@@ -168,6 +174,10 @@ class Querier:
         out: set[str] = set()
         for batch in self._live_batches(tenant):
             out |= batch_tag_values(batch, tag)
+        try:
+            out |= self.db.search_tag_values(tenant, tag)
+        except Exception:
+            log.exception("block tag-value lookup failed; serving live values only")
         return sorted(out)
 
     def traceql(self, tenant: str, query: str, start_s=0, end_s=0, limit=20,
