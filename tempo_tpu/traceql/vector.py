@@ -540,44 +540,65 @@ def evaluate_batch(pipeline: A.Pipeline, batch, dictionary) -> dict:
 
     tid = batch.cols["trace_id"]
     starts = batch.cols["start_unix_nano"]
-    ends = starts + batch.cols["duration_nano"]
+    durations = batch.cols["duration_nano"]
+    ends = starts + durations
     is_root = (batch.cols["parent_span_id"] == 0).all(axis=1)
     sid = batch.cols["span_id"]
     names = batch.cols["name"]
     service = batch.cols["service"]
 
+    # per-trace metadata computed in whole-column passes (the per-trace
+    # Python loop below only assembles already-reduced scalars — on
+    # match-heavy queries this loop used to dominate the whole path)
+    t_start = np.minimum.reduceat(starts, firsts)
+    t_end = np.maximum.reduceat(ends, firsts)
+    # first TRUE-root row per trace (fallback: the trace's first row)
+    root_row = firsts.copy()
+    has_root_arr = np.zeros(n_traces, bool)
+    root_rows_all = np.flatnonzero(is_root)
+    if len(root_rows_all):
+        root_seg = seg[root_rows_all]
+        # rows are in ascending order, so keep the FIRST root per segment
+        first_idx = np.unique(root_seg, return_index=True)[1]
+        root_row[root_seg[first_idx]] = root_rows_all[first_idx]
+        has_root_arr[root_seg[first_idx]] = True
+    # all trace-id / span-id bytes in two bulk byteswaps
+    tid_be = np.ascontiguousarray(tid[firsts]).astype(">u4")
+    m_rows_all = np.flatnonzero(mask)
+    m_seg = seg[m_rows_all]
+    sid_be = np.ascontiguousarray(sid[m_rows_all]).astype(">u4")
+    # matched rows grouped per trace: m_rows_all is sorted, so segment
+    # boundaries are a searchsorted over the hit traces
+    grp_bounds = np.searchsorted(m_seg, hit_traces)
+
     out = {}
-    for t in hit_traces:
-        lo = int(firsts[t])
-        hi = int(firsts[t + 1]) if t + 1 < n_traces else n
-        rows = np.arange(lo, hi)
-        tid_bytes = np.ascontiguousarray(tid[lo]).astype(">u4").tobytes()
-        roots = rows[is_root[lo:hi]]
-        root = int(roots[0]) if len(roots) else lo
-        # cap retained spans (earliest by start, span_id tiebreak — same
-        # rule as the object engine); matched keeps the true count
-        m_rows = rows[mask[lo:hi]]
-        if len(m_rows) > MAX_SPANS_PER_RESULT:
-            key = np.lexsort((
-                sid[m_rows, 1], sid[m_rows, 0], starts[m_rows],
-            ))
-            m_rows = m_rows[key[:MAX_SPANS_PER_RESULT]]
+    for j, t in enumerate(hit_traces):
+        lo_m = grp_bounds[j]
+        hi_m = grp_bounds[j + 1] if j + 1 < len(hit_traces) else len(m_rows_all)
+        if hi_m - lo_m > MAX_SPANS_PER_RESULT:
+            # earliest by (start, span_id) — same rule as the object engine
+            rows = m_rows_all[lo_m:hi_m]
+            key = np.lexsort((sid[rows, 1], sid[rows, 0], starts[rows]))
+            sel = lo_m + key[:MAX_SPANS_PER_RESULT]
+        else:
+            sel = range(lo_m, hi_m)
+        root = int(root_row[t])
         p = TracePartial(
-            trace_id=tid_bytes,
+            trace_id=tid_be[t].tobytes(),
             matched=int(m_count[t]),
-            start=int(starts[rows].min()),
-            end=int(ends[rows].max()),
+            start=int(t_start[t]),
+            end=int(t_end[t]),
             root_service=dictionary[int(service[root])],
             root_name=dictionary[int(names[root])],
-            has_root=bool(len(roots)),
+            has_root=bool(has_root_arr[t]),
             spans=[
                 (
-                    int(starts[r]),
-                    np.ascontiguousarray(sid[r]).astype(">u4").tobytes().hex(),
-                    dictionary[int(names[r])],
-                    int(batch.cols["duration_nano"][r]),
+                    int(starts[m_rows_all[i]]),
+                    sid_be[i].tobytes().hex(),
+                    dictionary[int(names[m_rows_all[i]])],
+                    int(durations[m_rows_all[i]]),
                 )
-                for r in m_rows
+                for i in sel
             ],
         )
         for (cnt, tot, mn, mx) in agg_parts:
@@ -589,7 +610,7 @@ def evaluate_batch(pipeline: A.Pipeline, batch, dictionary) -> dict:
                     float(mx[t]) if mx is not None else -np.inf,
                 )
             )
-        out[tid_bytes] = p
+        out[p.trace_id] = p
     return out
 
 
