@@ -230,11 +230,31 @@ def child_server():
         print(json.dumps({"dt": arms[cmd].one_rep()}), flush=True)
 
 
+def _watchdog(seconds: float):
+    """The axon tunnel can hang jax.devices() indefinitely (observed
+    in-round: device init blocked >2 min with the tunnel down). A hung
+    bench is worse than a failed one — the driver would wait forever —
+    so a daemon timer dumps a diagnostic and exits nonzero."""
+    import threading
+
+    def fire():
+        print(f"[bench] WATCHDOG: no result after {seconds:.0f}s — device "
+              f"init or a rep is hung (tunnel down?); aborting", file=sys.stderr)
+        sys.stderr.flush()
+        os._exit(1)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def main():
     if "--child-server" in sys.argv:
         child_server()
         return
 
+    dog = _watchdog(float(os.environ.get("BENCH_TIMEOUT_S", "2700")))
     jax = _setup_jax()
     platform = jax.devices()[0].platform
     n_dev = len(jax.devices())
@@ -326,6 +346,7 @@ def main():
             print(f"[bench] WARNING: {name} arm bloom fp {summary['bloom_fp_rate']}", file=sys.stderr)
     print(f"[bench] loadavg after: {_loadavg():.2f}", file=sys.stderr)
 
+    dog.cancel()
     print(json.dumps({
         "metric": "blocks_compacted_per_sec_per_chip",
         "value": round(blocks_per_s / max(n_dev, 1), 3),
