@@ -233,6 +233,71 @@ def cmd_query_search(args) -> int:
     return 0
 
 
+def cmd_query_search_tags(args) -> int:
+    """Tag names across a tenant's blocks (reference:
+    cmd-query-search-tags.go, straight against the backend)."""
+    from tempo_tpu import encoding as encoding_registry
+    from tempo_tpu.model.tags import batch_tag_names
+
+    be = _backend(args)
+    metas, _ = _tenant_metas(be, args.tenant)
+    names: set = set()
+    for m in metas:
+        blk = encoding_registry.from_version(m.version).open_block(m, be)
+        if hasattr(blk, "tag_names"):
+            names |= blk.tag_names()
+        else:
+            for batch in blk.iter_trace_batches():
+                names |= batch_tag_names(batch)
+    print(json.dumps({"tagNames": sorted(names)}, indent=2))
+    return 0
+
+
+def cmd_query_search_tag_values(args) -> int:
+    """Values of one tag across a tenant's blocks (reference:
+    cmd-query-search-tag-values.go)."""
+    from tempo_tpu import encoding as encoding_registry
+    from tempo_tpu.model.tags import batch_tag_values
+
+    be = _backend(args)
+    metas, _ = _tenant_metas(be, args.tenant)
+    vals: set = set()
+    for m in metas:
+        blk = encoding_registry.from_version(m.version).open_block(m, be)
+        if hasattr(blk, "tag_values"):
+            vals |= blk.tag_values(args.tag)
+        else:
+            for batch in blk.iter_trace_batches():
+                vals |= batch_tag_values(batch, args.tag)
+    print(json.dumps({"tagValues": sorted(vals)}, indent=2))
+    return 0
+
+
+def cmd_list_cache_summary(args) -> int:
+    """Bloom-filter bytes per compaction level — what the bloom cache
+    would hold for this tenant (reference: cmd-list-cachesummary.go)."""
+    from tempo_tpu.backend.base import bloom_name
+
+    be = _backend(args)
+    metas, _ = _tenant_metas(be, args.tenant)
+    by_level: dict[int, list] = {}
+    for m in metas:
+        by_level.setdefault(m.compaction_level, []).append(m)
+    rows = []
+    for lvl in sorted(by_level):
+        ms = by_level[lvl]
+        bloom_bytes = 0
+        for m in ms:
+            for s in range(m.bloom_shards):
+                try:
+                    bloom_bytes += len(be.read_named(m.tenant_id, m.block_id, bloom_name(s)))
+                except Exception:
+                    pass
+        rows.append([lvl, len(ms), f"{bloom_bytes:,}"])
+    _print_table(rows, ["lvl", "blocks", "bloom bytes"])
+    return 0
+
+
 # -- gen -------------------------------------------------------------------
 
 
@@ -329,6 +394,9 @@ def build_parser() -> argparse.ArgumentParser:
     lc = lst.add_parser("compaction-summary")
     lc.add_argument("tenant")
     lc.set_defaults(fn=cmd_list_compaction_summary)
+    lcs = lst.add_parser("cache-summary")
+    lcs.add_argument("tenant")
+    lcs.set_defaults(fn=cmd_list_cache_summary)
     li = lst.add_parser("index")
     li.add_argument("tenant")
     li.set_defaults(fn=cmd_list_index)
@@ -350,6 +418,13 @@ def build_parser() -> argparse.ArgumentParser:
     qt.add_argument("tenant")
     qt.add_argument("trace_id")
     qt.set_defaults(fn=cmd_query_trace)
+    qst = q.add_parser("search-tags")
+    qst.add_argument("tenant")
+    qst.set_defaults(fn=cmd_query_search_tags)
+    qsv = q.add_parser("search-tag-values")
+    qsv.add_argument("tenant")
+    qsv.add_argument("tag")
+    qsv.set_defaults(fn=cmd_query_search_tag_values)
     qs = q.add_parser("search")
     qs.add_argument("tenant")
     qs.add_argument("--tags", default="")

@@ -145,3 +145,26 @@ def test_convert_between_encodings(backend_dir, capsys):
     # and back again
     rc, out = _run(capsys, "--path", path, "convert", "single-tenant", vrow_id, "--to", "vtpu1")
     assert rc == 0 and "vtpu1" in out
+
+
+def test_query_search_tags(backend_dir, capsys):
+    path, _, traces = backend_dir
+    rc, out = _run(capsys, "--path", path, "query", "search-tags", "single-tenant")
+    assert rc == 0
+    names = json.loads(out)["tagNames"]
+    assert "service.name" in names and "name" in names
+
+
+def test_query_search_tag_values(backend_dir, capsys):
+    path, _, traces = backend_dir
+    svc = traces[0].batches[0][0]["service.name"]
+    rc, out = _run(capsys, "--path", path, "query", "search-tag-values", "single-tenant", "service.name")
+    assert rc == 0
+    assert svc in json.loads(out)["tagValues"]
+
+
+def test_list_cache_summary(backend_dir, capsys):
+    path, _, traces = backend_dir
+    rc, out = _run(capsys, "--path", path, "list", "cache-summary", "single-tenant")
+    assert rc == 0
+    assert "bloom bytes" in out
