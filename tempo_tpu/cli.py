@@ -237,18 +237,14 @@ def cmd_query_search_tags(args) -> int:
     """Tag names across a tenant's blocks (reference:
     cmd-query-search-tags.go, straight against the backend)."""
     from tempo_tpu import encoding as encoding_registry
-    from tempo_tpu.model.tags import batch_tag_names
+    from tempo_tpu.model.tags import block_tag_names
 
     be = _backend(args)
     metas, _ = _tenant_metas(be, args.tenant)
     names: set = set()
     for m in metas:
         blk = encoding_registry.from_version(m.version).open_block(m, be)
-        if hasattr(blk, "tag_names"):
-            names |= blk.tag_names()
-        else:
-            for batch in blk.iter_trace_batches():
-                names |= batch_tag_names(batch)
+        names |= block_tag_names(blk)
     print(json.dumps({"tagNames": sorted(names)}, indent=2))
     return 0
 
@@ -257,18 +253,14 @@ def cmd_query_search_tag_values(args) -> int:
     """Values of one tag across a tenant's blocks (reference:
     cmd-query-search-tag-values.go)."""
     from tempo_tpu import encoding as encoding_registry
-    from tempo_tpu.model.tags import batch_tag_values
+    from tempo_tpu.model.tags import block_tag_values
 
     be = _backend(args)
     metas, _ = _tenant_metas(be, args.tenant)
     vals: set = set()
     for m in metas:
         blk = encoding_registry.from_version(m.version).open_block(m, be)
-        if hasattr(blk, "tag_values"):
-            vals |= blk.tag_values(args.tag)
-        else:
-            for batch in blk.iter_trace_batches():
-                vals |= batch_tag_values(batch, args.tag)
+        vals |= block_tag_values(blk, args.tag)
     print(json.dumps({"tagValues": sorted(vals)}, indent=2))
     return 0
 
@@ -291,8 +283,9 @@ def cmd_list_cache_summary(args) -> int:
             for s in range(m.bloom_shards):
                 try:
                     bloom_bytes += len(be.read_named(m.tenant_id, m.block_id, bloom_name(s)))
-                except Exception:
-                    pass
+                except Exception as e:
+                    print(f"warning: bloom shard {s} of block {m.block_id} "
+                          f"unreadable ({e}); summary undercounts", file=sys.stderr)
         rows.append([lvl, len(ms), f"{bloom_bytes:,}"])
     _print_table(rows, ["lvl", "blocks", "bloom bytes"])
     return 0

@@ -297,20 +297,13 @@ class TempoDB:
                     if hit is not None:
                         self._tag_cache.move_to_end(key)
                         return hit
-                blk = self.encoding_for(meta.version).open_block(meta, self.backend, self.cfg.block)
-                if hasattr(blk, method):
-                    vals = set(getattr(blk, method)(*args))
-                else:
-                    # encodings without native tag enumeration (vrow1):
-                    # derive from the streamed trace batches
-                    from tempo_tpu.model.tags import batch_tag_names, batch_tag_values
+                from tempo_tpu.model.tags import block_tag_names, block_tag_values
 
-                    vals = set()
-                    for batch in blk.iter_trace_batches():
-                        if method == "tag_names":
-                            vals |= batch_tag_names(batch)
-                        else:
-                            vals |= batch_tag_values(batch, *args)
+                blk = self.encoding_for(meta.version).open_block(meta, self.backend, self.cfg.block)
+                if method == "tag_names":
+                    vals = block_tag_names(blk)
+                else:
+                    vals = block_tag_values(blk, *args)
                 with self._tag_cache_lock:
                     self._tag_cache[key] = vals
                     while len(self._tag_cache) > 2048:

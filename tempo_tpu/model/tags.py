@@ -82,3 +82,24 @@ def tag_values_from_columns(cols: dict, attrs: dict, d, tag: str) -> set[str]:
         elif vt == VT_FLOAT:
             out.add(repr(float(num)))
     return out
+
+
+def block_tag_names(blk) -> set[str]:
+    """Tag names of one backend block: native reader when the encoding
+    has one, streamed-batch fallback otherwise (vrow1). The ONE home for
+    this capability check — db._tag_fanout and the CLI both call it."""
+    if hasattr(blk, "tag_names"):
+        return set(blk.tag_names())
+    out: set[str] = set()
+    for batch in blk.iter_trace_batches():
+        out |= batch_tag_names(batch)
+    return out
+
+
+def block_tag_values(blk, tag: str) -> set[str]:
+    if hasattr(blk, "tag_values"):
+        return set(blk.tag_values(tag))
+    out: set[str] = set()
+    for batch in blk.iter_trace_batches():
+        out |= batch_tag_values(batch, tag)
+    return out

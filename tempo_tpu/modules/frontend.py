@@ -46,6 +46,11 @@ class FrontendConfig:
     query_ingesters_until_s: int = 3600  # recent window served by ingesters
     max_duration_s: int = 0  # per-tenant via overrides wins
     job_timeout_s: float = 60.0
+    # a shard still unfinished after this long gets a duplicate submitted
+    # and the first completion wins (reference: hedged_requests.go:26,
+    # HedgeRequestsAt ~2s); 0 disables. Duplicated partials are safe —
+    # every merge path dedupes by trace/span identity.
+    hedge_after_s: float = 2.0
 
 
 class Frontend:
@@ -71,23 +76,31 @@ class Frontend:
         identity."""
         from tempo_tpu.modules.worker import JobError
 
-        pendings = [self.broker.submit(tenant, d) for d in descs]
+        groups = [[self.broker.submit(tenant, d)] for d in descs]
         results: list = []
         terminal_errors: list = []  # client errors: never retried, never lost
         for attempt in range(self.cfg.max_retries + 1):
-            self.broker.wait_all(pendings, timeout_s=self.cfg.job_timeout_s)
-            # classify each pending exactly once — a job finishing between
+            self._wait_groups(tenant, groups, timeout_s=self.cfg.job_timeout_s)
+            # classify each group exactly once — a job finishing between
             # two passes must land in exactly one bucket
             failed = []
-            for p in pendings:
-                if p.event.is_set() and p.error is None:
-                    results.append(p.result)
-                elif p.error is not None and p.error.startswith(self._CLIENT_ERRORS):
-                    terminal_errors.append(JobError(p.error))  # not retryable
+            for grp in groups:
+                done_ok = next((p for p in grp if p.event.is_set() and p.error is None), None)
+                if done_ok is not None:
+                    results.append(done_ok.result)
+                    continue
+                client_err = next(
+                    (p for p in grp
+                     if p.error is not None and p.error.startswith(self._CLIENT_ERRORS)),
+                    None,
+                )
+                if client_err is not None:
+                    terminal_errors.append(JobError(client_err.error))  # not retryable
                 else:
-                    failed.append(p)
+                    failed.append(grp)
             if not failed or attempt == self.cfg.max_retries:
-                for p in failed:
+                for grp in failed:
+                    p = grp[0]
                     terminal_errors.append(
                         JobError(p.error) if p.error is not None
                         else TimeoutError(f"job {p.job_id} timed out")
@@ -97,8 +110,46 @@ class Frontend:
                 "retrying %d failed query jobs (attempt %d/%d)",
                 len(failed), attempt + 1, self.cfg.max_retries,
             )
-            pendings = [self.broker.submit(tenant, p.desc) for p in failed]
+            groups = [[self.broker.submit(tenant, grp[0].desc)] for grp in failed]
         return results, terminal_errors
+
+    def _wait_groups(self, tenant: str, groups: list, timeout_s: float) -> None:
+        """Wait until every group has a finished member or the timeout
+        passes; after cfg.hedge_after_s, unfinished groups get a
+        DUPLICATE submission and the first completion wins (reference:
+        the frontend's hedged-requests middleware, hedged_requests.go:26
+        — tail shards ride a second worker instead of stalling the whole
+        query)."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        hedge_at = (
+            _time.monotonic() + self.cfg.hedge_after_s
+            if self.cfg.hedge_after_s > 0
+            else None
+        )
+        while True:
+            unfinished = [g for g in groups if not any(p.event.is_set() for p in g)]
+            if not unfinished:
+                return
+            now = _time.monotonic()
+            if now >= deadline:
+                return
+            if hedge_at is not None and now >= hedge_at:
+                for g in unfinished:
+                    # hedge only jobs a worker has actually LEASED
+                    # (deadline set by pull) and at most once per group —
+                    # duplicating QUEUED jobs would amplify load exactly
+                    # when the broker is saturated (the HTTP hedger has
+                    # the same in-flight-only rule)
+                    if len(g) == 1 and g[0].deadline > 0:
+                        log.info("hedging slow query job %s", g[0].job_id)
+                        g.append(self.broker.submit(tenant, g[0].desc))
+            # bounded slice on one unfinished group's NEWEST member (the
+            # hedge, when present, is the likely finisher); the loop
+            # re-checks every group each tick
+            slice_end = deadline if (hedge_at is None or now >= hedge_at) else min(deadline, hedge_at)
+            unfinished[0][-1].event.wait(timeout=max(0.01, min(0.25, slice_end - now)))
 
     # ------------------------------------------------------------------
     def find_trace_by_id(self, tenant: str, trace_id: bytes):

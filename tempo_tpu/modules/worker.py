@@ -147,21 +147,6 @@ class JobBroker:
                 p.error = f"requeue after lease expiry failed: {e}"
                 p.event.set()
 
-    def wait_all(self, pendings: list, timeout_s: float = 60.0):
-        """Wait for every pending job; returns (results, errors)."""
-        deadline = time.monotonic() + timeout_s
-        results, errors = [], []
-        for p in pendings:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0 or not p.event.wait(timeout=remaining):
-                errors.append(TimeoutError(f"job {p.job_id} timed out"))
-                continue
-            if p.error is not None:
-                errors.append(JobError(p.error))
-            else:
-                results.append(p.result)
-        return results, errors
-
     def stop(self) -> None:
         self.queue.stop()
 

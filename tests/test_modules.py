@@ -402,3 +402,49 @@ class TestReviewRegressions:
 
 def _register_in_ring(path, i):  # top-level: spawn target must be picklable
     Ring(FileKV(path)).register(f"ing-{i}")
+
+
+class TestHedgedJobs:
+    def test_slow_shard_completes_via_hedge(self):
+        """A worker that wedges on the FIRST pull of a job must not stall
+        the query: after hedge_after_s a duplicate dispatches and its
+        result wins (reference: the frontend's hedged-requests
+        middleware, hedged_requests.go:26)."""
+        import threading
+        import time as _time
+
+        from tempo_tpu.modules.frontend import Frontend, FrontendConfig
+        from tempo_tpu.modules.worker import JobBroker
+
+        broker = JobBroker(lease_s=60.0)
+        fe = Frontend(broker, db=None,
+                      cfg=FrontendConfig(hedge_after_s=0.2, job_timeout_s=10.0,
+                                         max_retries=0))
+        wedged_once = threading.Event()
+        stop = threading.Event()
+
+        def worker():
+            while not stop.is_set():
+                item = broker.pull(timeout=0.2)
+                if item is None:
+                    continue
+                job_id, _tenant, desc = item
+                if desc.get("wedge") and not wedged_once.is_set():
+                    wedged_once.set()
+                    stop.wait(30)  # simulate a stuck worker holding the lease
+                    continue
+                broker.complete(job_id, result={"ok": desc.get("n")})
+
+        threads = [threading.Thread(target=worker, daemon=True) for _ in range(2)]
+        for t in threads:
+            t.start()
+        t0 = _time.monotonic()
+        results, errors = fe._run_jobs("t", [{"wedge": True, "n": 1}, {"n": 2}])
+        dt = _time.monotonic() - t0
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert not errors, errors
+        assert sorted(r["ok"] for r in results) == [1, 2]
+        assert dt < 8.0, f"hedge did not rescue the wedged shard ({dt:.1f}s)"
+        assert wedged_once.is_set()
