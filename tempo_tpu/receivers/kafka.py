@@ -22,7 +22,16 @@ import socket
 import struct
 import threading
 
+from tempo_tpu.util import metrics
+
 log = logging.getLogger(__name__)
+
+_records_total = metrics.counter(
+    "tempo_distributor_kafka_records_total", "Kafka records consumed")
+_spans_total = metrics.counter(
+    "tempo_distributor_kafka_spans_total", "Spans ingested via Kafka")
+_errors_total = metrics.counter(
+    "tempo_distributor_kafka_errors_total", "Kafka consume/decode errors")
 
 API_FETCH = 1
 API_LIST_OFFSETS = 2
@@ -383,6 +392,7 @@ class KafkaReceiver:
                 records = self._client.fetch(self.topic, p, off)
             except KafkaFetchError as e:
                 self.errors += 1
+                _errors_total.inc()
                 if e.code == ERR_OFFSET_OUT_OF_RANGE:
                     # the tracked offset fell off the log: resume from
                     # the earliest retained offset
@@ -409,12 +419,16 @@ class KafkaReceiver:
                     traces = otlp.decode_traces_request(value)
                     if traces:
                         self.push(traces, org_id=self.org_id)
-                    self.spans += sum(t.span_count() for t in traces)
+                    n_spans = sum(t.span_count() for t in traces)
+                    self.spans += n_spans
+                    _spans_total.inc(n_spans)
                 except Exception:
                     self.errors += 1
+                    _errors_total.inc()
                     log.exception("kafka record decode/push failed")
                 self._offsets[p] = rec_off + 1
                 self.records += 1
+                _records_total.inc()
                 n += 1
         return n
 
@@ -430,6 +444,7 @@ class KafkaReceiver:
             except Exception:
                 # a non-I/O failure must never kill the ingest thread
                 self.errors += 1
+                _errors_total.inc()
                 log.exception("kafka poll failed")
                 self._stop.wait(1.0)
             self._stop.wait(self.poll_interval_s)
