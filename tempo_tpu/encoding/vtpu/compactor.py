@@ -44,7 +44,7 @@ import jax.numpy as jnp
 from tempo_tpu.backend.base import BlockMeta, TypedBackend
 from tempo_tpu.encoding.common import CompactionOptions
 from tempo_tpu.encoding.vtpu.block import VtpuBackendBlock
-from tempo_tpu.encoding.vtpu.create import write_block
+from tempo_tpu.encoding.vtpu.create import DeviceSketchAccumulator, write_block
 from tempo_tpu.model.columnar import (
     ATTR_COLUMNS,
     CODE_COLUMNS,
@@ -59,6 +59,14 @@ from tempo_tpu.util.pipeline import ReadAhead, overlap_enabled, prefetch_iter
 # span columns whose values can legitimately differ between RF copies of
 # the same span; trace_id/span_id are the identity key.
 _PAYLOAD_COLS = [c for c in SPAN_COLUMNS if c not in ("trace_id", "span_id")]
+
+
+def _sketch_tee(gen, acc):
+    """Feed each merged batch to the device sketch accumulator (async
+    dispatch) on its way to the block writer."""
+    for b in gen:
+        acc.update(b)
+        yield b
 
 
 class VtpuCompactor:
@@ -81,6 +89,11 @@ class VtpuCompactor:
             _BlockStream(VtpuBackendBlock(m, backend, cfg), out_dict) for m in metas
         ]
         sharded = _ShardedTileMerger.build(self.opts, metas) if self.opts.mesh is not None else None
+        sketcher = None
+        if sharded is None:
+            # single-device sketch plane: per-batch async device updates
+            # overlap the host's column encode; one small D2H at the end
+            sketcher = DeviceSketchAccumulator(cfg, sum(m.total_objects for m in metas))
 
         level = max(m.compaction_level for m in metas) + 1
         # merge (device/native) runs on a producer thread, overlapped with
@@ -88,18 +101,20 @@ class VtpuCompactor:
         # SURVEY.md 7.4's decode->kernel->encode double buffering. On a
         # single-core host the overlap is pure overhead (see
         # pipeline.overlap_enabled) and the generator runs inline.
-        gen = self._stream_merge(streams, out_dict, sharded)
+        inner = self._stream_merge(streams, out_dict, sharded)
+        gen = _sketch_tee(inner, sketcher) if sketcher else inner
         batches = prefetch_iter(gen, depth=2) if overlap_enabled() else gen
         try:
             out = write_block(
                 batches, tenant, backend, cfg, compaction_level=level,
-                sketches=(sharded.finish if sharded else None),
+                sketches=(sharded.finish if sharded else sketcher.finish),
             )
         finally:
             # stop the producer thread + per-stream readahead even when
             # write/encode fails mid-stream (a long-lived compactor daemon
             # must not leak a thread per failed job)
             batches.close()
+            inner.close()
             for s in streams:
                 s.close()
         return [out] if out else []

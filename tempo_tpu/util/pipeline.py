@@ -63,8 +63,16 @@ def prefetch_iter(iterable, depth: int = 2):
                     return
         except BaseException as e:  # propagate into the consuming thread
             _put((_SENTINEL, e))
-            return
-        _put((_SENTINEL, None))
+        else:
+            _put((_SENTINEL, None))
+        finally:
+            # close the source ON the producer thread: the generator is
+            # guaranteed not to be executing here, so this cannot race a
+            # cross-thread close() (ValueError: generator already
+            # executing) the way a consumer-side close would
+            close = getattr(iterable, "close", None)
+            if close is not None:
+                close()
 
     t = threading.Thread(target=run, daemon=True, name="prefetch-iter")
     t.start()
@@ -78,6 +86,19 @@ def prefetch_iter(iterable, depth: int = 2):
             yield item
     finally:
         stop.set()
+        # quiesce before returning control: the caller's cleanup (closing
+        # block streams under the producer) is only safe once the
+        # producer has actually exited. Bounded join: a producer stuck in
+        # an untimed backend read must not convert a failed job into a
+        # hung daemon — leak the (daemon) thread with a warning instead,
+        # which is the pre-join behavior for exactly that pathology.
+        t.join(timeout=60.0)
+        if t.is_alive():  # pragma: no cover - needs a wedged source
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "prefetch producer did not quiesce within 60s; leaking daemon thread"
+            )
 
 
 class ReadAhead:

@@ -316,3 +316,66 @@ class TestTracing:
         assert "traceID=" in caplog.text
         span = list(exported[0][0].all_spans())[0]
         assert span.attributes["log"] == ["inside the span"]
+
+
+class TestPrefetchIter:
+    """prefetch_iter lifecycle: the producer thread owns the source's
+    close(), so a consumer-side close can never race a generator that is
+    mid-next() on the producer (ValueError: generator already executing)."""
+
+    def test_drains_and_closes_source(self):
+        from tempo_tpu.util.pipeline import prefetch_iter
+
+        closed = []
+
+        def src():
+            try:
+                yield from range(5)
+            finally:
+                closed.append(True)
+
+        assert list(prefetch_iter(src(), depth=2)) == [0, 1, 2, 3, 4]
+        assert closed == [True]
+
+    def test_consumer_close_midstream_quiesces_producer(self):
+        from tempo_tpu.util.pipeline import prefetch_iter
+
+        in_item = threading.Event()
+        release = threading.Event()
+        closed = []
+
+        def src():
+            try:
+                for i in range(100):
+                    if i == 1:
+                        in_item.set()
+                        release.wait(5)  # producer is mid-next() here
+                    yield i
+            finally:
+                closed.append(True)
+
+        g = prefetch_iter(src(), depth=1)
+        assert next(g) == 0
+        assert in_item.wait(5)
+        release.set()
+        g.close()  # must join the producer; source closed exactly once
+        assert closed == [True]
+
+    def test_producer_exception_reraises_and_closes(self):
+        from tempo_tpu.util.pipeline import prefetch_iter
+
+        closed = []
+
+        def src():
+            try:
+                yield 1
+                raise RuntimeError("boom")
+            finally:
+                closed.append(True)
+
+        g = prefetch_iter(src(), depth=2)
+        assert next(g) == 1
+        with pytest.raises(RuntimeError, match="boom"):
+            for _ in g:
+                pass
+        assert closed == [True]
