@@ -31,7 +31,7 @@ from dataclasses import asdict, is_dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, unquote, urlparse
 
-from tempo_tpu import receivers
+from tempo_tpu import receivers, traceql
 from tempo_tpu.api import params as api_params
 from tempo_tpu.api.params import BadRequest
 from tempo_tpu.app import RoleUnavailable
@@ -148,6 +148,11 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             code = self._handle(method, url)
         except BadRequest as e:
+            code = 400
+            self._send_error(400, str(e))
+        except traceql.ParseError as e:
+            # malformed or ill-typed query is the caller's error
+            # (reference maps TraceQL parse/validate errors to 400)
             code = 400
             self._send_error(400, str(e))
         except receivers.UnsupportedPayload as e:

@@ -114,7 +114,13 @@ class VtpuCompactor:
             # write/encode fails mid-stream (a long-lived compactor daemon
             # must not leak a thread per failed job)
             batches.close()
-            inner.close()
+            try:
+                inner.close()
+            except ValueError:
+                # prefetch join timed out with the producer wedged inside
+                # the generator; the thread is leaked (already logged) and
+                # the original exception must not be masked here
+                pass
             for s in streams:
                 s.close()
         return [out] if out else []

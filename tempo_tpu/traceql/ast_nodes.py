@@ -229,6 +229,144 @@ def _flip(op: str) -> str:
     return {">": "<", "<": ">", ">=": "<=", "<=": ">="}.get(op, op)
 
 
+# ---------------------------------------------------------------------------
+# static validation (reference: pkg/traceql/ast.go validate() — type
+# checking after parse; test corpus section `validate_fails` in
+# pkg/traceql/test_examples.yaml)
+# ---------------------------------------------------------------------------
+
+# static types: int/float/duration unify into "number" (the reference
+# accepts `{ 1 * 1h = 1 }`); attributes are dynamically typed so they
+# unify with anything ("unknown").
+_LITERAL_TYPES = {
+    "int": "number",
+    "float": "number",
+    "duration": "number",
+    "string": "string",
+    "bool": "bool",
+    "status": "status",
+    "kind": "kind",
+    "nil": "nil",
+}
+_INTRINSIC_TYPES = {
+    "duration": "number",
+    "childCount": "number",
+    "name": "string",
+    "status": "status",
+    "kind": "kind",
+    "parent": "span",
+}
+
+
+def _compatible(a: str, b: str) -> bool:
+    if "unknown" in (a, b) or a == b:
+        return True
+    if "nil" in (a, b):  # nil compares against attributes and parent
+        return {a, b} <= {"nil", "span", "unknown"}
+    return False
+
+
+def static_type(e: Expr) -> str:
+    """Infer the static type of a field expression, raising TypeError_
+    on an ill-typed subtree."""
+    if isinstance(e, Literal):
+        return _LITERAL_TYPES[e.kind]
+    if isinstance(e, Attribute):
+        return "unknown"
+    if isinstance(e, Intrinsic):
+        return _INTRINSIC_TYPES[e.name]
+    if isinstance(e, Unary):
+        t = static_type(e.expr)
+        if e.op == "-":
+            if t not in ("number", "unknown"):
+                raise TypeError_(f"operator - not defined for {t}")
+            return "number"
+        if t not in ("bool", "unknown"):
+            raise TypeError_(f"operator ! not defined for {t}")
+        return "bool"
+    if isinstance(e, Binary):
+        lt, rt = static_type(e.lhs), static_type(e.rhs)
+        op = e.op
+        if op in ARITH_OPS:
+            for t in (lt, rt):
+                if t not in ("number", "unknown"):
+                    raise TypeError_(f"operator {op} not defined for {t}")
+            return "number"
+        if op in ("&&", "||"):
+            for t in (lt, rt):
+                if t not in ("bool", "unknown"):
+                    raise TypeError_(f"operator {op} not defined for {t}")
+            return "bool"
+        if op in ("=~", "!~"):
+            if lt not in ("string", "unknown"):
+                raise TypeError_(f"operator {op} requires a string, got {lt}")
+            return "bool"
+        if op in ("=", "!="):
+            if not _compatible(lt, rt):
+                raise TypeError_(f"cannot compare {lt} with {rt}")
+            return "bool"
+        if op in (">", ">=", "<", "<="):
+            for t in (lt, rt):
+                if t not in ("number", "string", "unknown"):
+                    raise TypeError_(f"operator {op} not defined for {t}")
+            if not _compatible(lt, rt):
+                raise TypeError_(f"cannot compare {lt} with {rt}")
+            return "bool"
+        raise TypeError_(f"unknown operator {op}")
+    raise TypeError_(f"cannot type {e!r}")
+
+
+def _references_span(e: Expr) -> bool:
+    if isinstance(e, (Attribute, Intrinsic)):
+        return True
+    if isinstance(e, Unary):
+        return _references_span(e.expr)
+    if isinstance(e, Binary):
+        return _references_span(e.lhs) or _references_span(e.rhs)
+    return False
+
+
+def validate(pipeline: "Pipeline") -> None:
+    """Static type checking over a parsed pipeline; raises TypeError_.
+
+    Intentional supersets vs the reference's validate_fails corpus: this
+    engine actually evaluates min/max/sum/avg aggregate pipelines and
+    scalar filters over them, so the reference's 'aggregates not
+    supported yet at this time' rejections are accepted here.
+    """
+
+    def walk(stage):
+        if isinstance(stage, SpansetFilter):
+            if stage.expr is not None:
+                t = static_type(stage.expr)
+                if t not in ("bool", "unknown"):
+                    raise TypeError_(f"spanset filter must be boolean, got {t}")
+        elif isinstance(stage, SpansetOp):
+            walk(stage.lhs)
+            walk(stage.rhs)
+        elif isinstance(stage, AggregateFilter):
+            if stage.field_expr is not None:
+                t = static_type(stage.field_expr)
+                if t not in ("number", "unknown"):
+                    raise TypeError_(f"{stage.agg}() requires a numeric field, got {t}")
+                if not _references_span(stage.field_expr):
+                    raise TypeError_(f"{stage.agg}() must reference the span")
+            rt = _LITERAL_TYPES[stage.rhs.kind]
+            if rt not in ("number", "unknown"):
+                raise TypeError_(f"cannot compare {stage.agg}() with {rt}")
+        elif isinstance(stage, GroupBy):
+            static_type(stage.expr)
+            if not _references_span(stage.expr):
+                raise TypeError_("by() must reference the span")
+        elif isinstance(stage, Pipeline):
+            for s in stage.stages:
+                walk(s)
+        # Coalesce / Select need no checks (Select's parser already
+        # restricts arguments to field nodes)
+
+    walk(pipeline)
+
+
 def _is_nil_literal(e: Expr) -> bool:
     return isinstance(e, Literal) and e.kind == "nil"
 

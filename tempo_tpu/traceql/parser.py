@@ -275,7 +275,15 @@ class Parser:
         raise ParseError(f"expected literal, got {t.text!r} at {t.pos}")
 
 
-def parse(src: str) -> A.Pipeline:
+def parse(src: str, validate: bool = True) -> A.Pipeline:
+    """Parse + statically validate (reference runs the same two phases:
+    yacc parse then ast.validate(), both surfacing as query errors)."""
     if not src or not src.strip():
         raise ParseError("empty query")
-    return Parser(src).parse()
+    p = Parser(src).parse()
+    if validate:
+        try:
+            A.validate(p)
+        except A.TypeError_ as e:
+            raise ParseError(f"invalid query: {e}") from e
+    return p

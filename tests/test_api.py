@@ -101,6 +101,17 @@ def _post(url, body, content_type, headers=None):
 
 
 class TestServer:
+    def test_bad_traceql_query_is_client_error(self, served_app):
+        """Malformed or ill-typed queries map to 400, not 500 (reference
+        returns StatusBadRequest on TraceQL parse/validate errors)."""
+        import urllib.parse
+
+        _, server = served_app
+        for q in ("{ <", "{ 1 + 1 }", "{ -true }", "{ status > ok }"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(f"{server.url}/api/search?q=" + urllib.parse.quote(q))
+            assert ei.value.code == 400, q
+
     def test_otlp_ingest_query_search(self, served_app):
         app, server = served_app
         trace = make_trace(seed=3, n_spans=6)

@@ -176,6 +176,87 @@ PARSE_FAILS = [
 ]
 
 
+# Ported from the reference's validate_fails section
+# (pkg/traceql/test_examples.yaml): queries that parse but fail static
+# type validation (pkg/traceql/ast.go validate()).
+VALIDATE_FAILS = [
+    # span expressions must evaluate to a boolean
+    '{ 1 + 1 }',
+    '{ parent }',
+    '{ status }',
+    '{ ok }',
+    '{ 1.1 }',
+    '{ 1h }',
+    '{ "foo" }',
+    # binary operators - incorrect types
+    '{ 1 + "foo" = 1 }',
+    '{ 1 - true = 1 }',
+    '{ 1 / ok = 1 }',
+    '{ 1 % parent = 1 }',
+    '{ 1 ^ name = 1 }',
+    '{ 1 = "foo" }',
+    '{ 1 != true }',
+    '{ 1 > ok }',
+    '{ 1 >= parent }',
+    '{ 1 = name }',
+    '{ 1 && "foo" }',
+    '{ 1 || ok }',
+    '{ true || 1.1 }',
+    '{ "foo" = childCount }',
+    '{ status > ok }',
+    # unary operators - incorrect types
+    '{ -true }',
+    '{ -"foo" = "bar" }',
+    '{ -ok = status }',
+    '{ -parent = nil }',
+    '{ -name = "foo" }',
+    '{ !"foo" = "bar" }',
+    '{ !ok = status }',
+    '{ !parent = nil }',
+    '{ !name = "foo" }',
+    '{ !1 = 1 }',
+    '{ !1h = 1 }',
+    '{ !1.1 = 1.1 }',
+    # scalar expressions must evaluate to a number
+    'max(name) = "foo"',
+    'avg("foo") = "bar"',
+    'max(status) = ok',
+    'min(1 = 3) = 1',
+    # scalar expressions must reference the span
+    'sum(3) = 2',
+    'max(1h + 2h) > 1',
+    'min(1.1 - 3) > 1',
+    # group expressions must reference the span
+    '{ true } | by(1)',
+    '{ true } | by("foo")',
+    # scalar filters have to match types
+    'min(1) = "foo"',
+    'avg(childCount) > "foo"',
+    'max(duration) < ok',
+]
+
+# The reference's validate_fails also rejects these as 'aggregates not
+# supported yet at this time' / 'scalar filter expressions not
+# supported' — this engine implements them, so they are VALID here
+# (documented superset; evaluation covered by tests/test_traceql.py).
+SUPPORTED_SUPERSET = [
+    'min(childCount) < 2',
+    'max(duration) >= 1s',
+    'max(duration) > 1',
+    '{ true } | max(duration) = 1h',
+    '{ true } | min(duration) = 1h',
+    '{ true } | sum(duration) = 1h',
+    '{ true } | max(.a) = 1',
+    '{ true } | max(parent.a) = 1',
+    '{ true } | max(span.a) = 1',
+    '{ true } | max(resource.a) = 1',
+    '{ true } | max(1 + .a) = 1',
+    '{ true } | max((1 + .a) * 2) = 1',
+    '{ true } | by(3 * .field - 2) | max(duration) < 1s',
+    'max(duration) > 3s | { status = error || .http.status = 500 }',
+]
+
+
 @pytest.mark.parametrize("q", VALID)
 def test_valid_parses(q):
     p = parse(q)
@@ -186,6 +267,19 @@ def test_valid_parses(q):
 def test_invalid_rejected(q):
     with pytest.raises(ParseError):
         parse(q)
+
+
+@pytest.mark.parametrize("q", VALIDATE_FAILS)
+def test_ill_typed_rejected(q):
+    with pytest.raises(ParseError, match="invalid query"):
+        parse(q)
+    # but each still parses structurally with validation off
+    assert parse(q, validate=False).stages
+
+
+@pytest.mark.parametrize("q", SUPPORTED_SUPERSET)
+def test_supported_superset_accepted(q):
+    assert parse(q).stages
 
 
 # --- structural spot checks -------------------------------------------------
