@@ -49,6 +49,23 @@ _req_hist = metrics.histogram("tempo_request_duration_seconds", "HTTP request la
 metrics.gauge("tempo_build_info", "Build information").set(1, version=VERSION)
 
 
+def _dict_diff(current, defaults):
+    """Nested keys in `current` that differ from `defaults`."""
+    if not isinstance(current, dict) or not isinstance(defaults, dict):
+        return current
+    out = {}
+    for k, v in current.items():
+        if k not in defaults:
+            out[k] = v
+        elif isinstance(v, dict) and isinstance(defaults[k], dict):
+            sub = _dict_diff(v, defaults[k])
+            if sub:
+                out[k] = sub
+        elif v != defaults[k]:
+            out[k] = v
+    return out
+
+
 def _config_dict(cfg) -> dict:
     if is_dataclass(cfg) and not isinstance(cfg, type):
         return asdict(cfg)
@@ -288,10 +305,48 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, {"version": VERSION, "goVersion": "n/a", "pythonNative": True})
             return 200
         if path == "/status/config":
-            self._send_json(200, _config_dict(app.cfg))
+            # ?mode=defaults dumps a pristine config; ?mode=diff only the
+            # keys changed from defaults (reference writeStatusConfig,
+            # cmd/tempo/app/app.go:246-270)
+            mode = qs.get("mode", [""])[0]
+            if mode == "defaults":
+                self._send_json(200, _config_dict(type(app.cfg)()))
+            elif mode == "diff":
+                self._send_json(
+                    200, _dict_diff(_config_dict(app.cfg), _config_dict(type(app.cfg)()))
+                )
+            elif mode == "":
+                self._send_json(200, _config_dict(app.cfg))
+            else:
+                raise BadRequest(f"unknown config mode {mode!r}")
+            return 200
+        if path == "/status/runtime_config":
+            # hot-reloaded per-tenant overrides (reference: runtime_config
+            # status endpoint, cmd/tempo/app/app.go:364)
+            ov = getattr(app, "overrides", None)
+            if ov is None:
+                self._send_json(200, {"defaults": {}, "tenants": {}})
+            else:
+                ov.maybe_reload()
+                doc = {
+                    "defaults": _config_dict(ov.for_tenant("")),
+                    "tenants": {
+                        t: _config_dict(ov.for_tenant(t)) for t in ov.tenants_with_overrides()
+                    },
+                }
+                self._send_json(200, doc)
             return 200
         if path == "/status/services":
             self._send_json(200, app.service_states() if hasattr(app, "service_states") else {"app": "Running"})
+            return 200
+        if path == "/status/usage-stats":
+            # current anonymous usage report (reference: PathUsageStats,
+            # pkg/api/http.go:61 + pkg/usagestats/reporter.go)
+            rep = getattr(app, "usage_reporter", None)
+            if rep is None:
+                self._send_json(200, {"enabled": False})
+            else:
+                self._send_json(200, {"enabled": True, **rep.build_report()})
             return 200
         if path == "/status/profile":
             # sampling CPU profile of all threads (reference analog:
@@ -380,6 +435,8 @@ _ENDPOINTS = [
     "GET /status/services",
     "GET /status/endpoints",
     "GET /status/profile",
+    "GET /status/usage-stats",
+    "GET /status/runtime_config",
 ]
 
 

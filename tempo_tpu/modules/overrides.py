@@ -10,10 +10,12 @@ at load so typos fail loudly (the reference's strict YAML option).
 from __future__ import annotations
 
 import dataclasses
-import json
 import logging
 import os
 import threading
+
+import yaml
+
 from dataclasses import dataclass, field
 
 log = logging.getLogger(__name__)
@@ -66,9 +68,13 @@ class Overrides:
             return
         try:
             with open(self.path) as f:
-                doc = json.load(f)
+                # YAML like the reference's runtimeconfig overrides file
+                # (JSON files keep working: JSON is a YAML subset)
+                doc = yaml.safe_load(f) or {}
             per_tenant = {}
-            for tenant, knobs in doc.get("overrides", {}).items():
+            # empty `overrides:` key / tenant block parse as None in YAML
+            for tenant, knobs in (doc.get("overrides") or {}).items():
+                knobs = knobs or {}
                 unknown = set(knobs) - _KNOWN
                 if unknown:
                     raise ValueError(f"tenant {tenant}: unknown limit keys {sorted(unknown)}")

@@ -266,6 +266,14 @@ def _compatible(a: str, b: str) -> bool:
     return False
 
 
+def _int_backed_enum(node: Expr, t: str) -> bool:
+    """The status/kind INTRINSICS are int-backed columns, so numeric
+    literals compare against them (`{ status = 2 }` worked before static
+    validation and must keep working). Keyword literals are not numeric:
+    `{ 1 > ok }` stays rejected like the reference corpus."""
+    return t in ("status", "kind") and isinstance(node, Intrinsic)
+
+
 def static_type(e: Expr) -> str:
     """Infer the static type of a field expression, raising TypeError_
     on an ill-typed subtree."""
@@ -301,11 +309,16 @@ def static_type(e: Expr) -> str:
             if lt not in ("string", "unknown"):
                 raise TypeError_(f"operator {op} requires a string, got {lt}")
             return "bool"
+        enum_num = (_int_backed_enum(e.lhs, lt) and rt in ("number", "unknown")) or (
+            _int_backed_enum(e.rhs, rt) and lt in ("number", "unknown")
+        )
         if op in ("=", "!="):
-            if not _compatible(lt, rt):
+            if not (_compatible(lt, rt) or enum_num):
                 raise TypeError_(f"cannot compare {lt} with {rt}")
             return "bool"
         if op in (">", ">=", "<", "<="):
+            if enum_num:  # { status > 1 } orders over the raw int
+                return "bool"
             for t in (lt, rt):
                 if t not in ("number", "string", "unknown"):
                     raise TypeError_(f"operator {op} not defined for {t}")

@@ -101,6 +101,57 @@ def _post(url, body, content_type, headers=None):
 
 
 class TestServer:
+    def test_status_usage_stats(self, served_app, tmp_path):
+        """/status/usage-stats shows the current report when reporting is
+        enabled, and enabled=False otherwise (reference PathUsageStats)."""
+        _, server = served_app
+        status, body, _ = _get(f"{server.url}/status/usage-stats")
+        assert status == 200 and json.loads(body) == {"enabled": False}
+
+        from tempo_tpu.usagestats import UsageStatsConfig
+
+        app2 = App(
+            AppConfig(
+                db=DBConfig(backend="local", backend_path=str(tmp_path / "b2"), wal_path=str(tmp_path / "w2")),
+                usage_stats=UsageStatsConfig(enabled=True),
+            )
+        )
+        server2 = TempoServer(app2).start()
+        try:
+            status, body, _ = _get(f"{server2.url}/status/usage-stats")
+            doc = json.loads(body)
+            assert status == 200 and doc["enabled"] is True
+            assert doc["clusterID"] and "metrics" in doc
+        finally:
+            server2.stop()
+            app2.shutdown()
+
+    def test_status_config_modes_and_runtime_config(self, served_app):
+        """/status/config?mode=diff|defaults and /status/runtime_config
+        (reference writeStatusConfig + runtime_config endpoints)."""
+        _, server = served_app
+        status, body, _ = _get(f"{server.url}/status/config?mode=defaults")
+        assert status == 200
+        defaults = json.loads(body)
+        assert defaults["db"]["backend"] == "local" and defaults["db"]["backend_path"] == ""
+
+        status, body, _ = _get(f"{server.url}/status/config?mode=diff")
+        assert status == 200
+        diff = json.loads(body)
+        # served_app sets backend_path/wal_path away from defaults
+        assert set(diff) == {"db"} and "backend_path" in diff["db"]
+        assert "backend" not in diff["db"]  # unchanged keys excluded
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{server.url}/status/config?mode=bogus")
+        assert ei.value.code == 400
+
+        status, body, _ = _get(f"{server.url}/status/runtime_config")
+        assert status == 200
+        doc = json.loads(body)
+        assert "max_bytes_per_trace" in doc["defaults"] or doc["defaults"]
+        assert doc["tenants"] == {}
+
     def test_bad_traceql_query_is_client_error(self, served_app):
         """Malformed or ill-typed queries map to 400, not 500 (reference
         returns StatusBadRequest on TraceQL parse/validate errors)."""

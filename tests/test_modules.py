@@ -100,6 +100,31 @@ class TestOverrides:
         assert ov.for_tenant("acme").max_traces_per_user == 7
         assert ov.for_tenant("other").max_traces_per_user == 100
 
+    def test_yaml_overrides_file(self, tmp_path):
+        """The reference's runtimeconfig overrides file is YAML; JSON
+        keeps working as a YAML subset."""
+        p = tmp_path / "overrides.yaml"
+        p.write_text("overrides:\n  acme:\n    max_traces_per_user: 7\n    forwarders: [otlp-a]\n")
+        ov = Overrides(Limits(max_traces_per_user=100), str(p))
+        assert ov.for_tenant("acme").max_traces_per_user == 7
+        assert ov.for_tenant("acme").forwarders == ("otlp-a",)
+        assert ov.tenants_with_overrides() == ["acme"]
+
+    def test_yaml_empty_overrides_clears_tenants(self, tmp_path):
+        """`overrides:` with no tenants (YAML None) clears all overrides
+        instead of crashing the reload and serving stale limits."""
+        p = tmp_path / "overrides.yaml"
+        p.write_text("overrides:\n  acme:\n    max_traces_per_user: 7\n")
+        ov = Overrides(Limits(max_traces_per_user=100), str(p))
+        assert ov.tenants_with_overrides() == ["acme"]
+        p.write_text("overrides:\n")
+        ov._load(force=True)
+        assert ov.tenants_with_overrides() == []
+        # an empty tenant block is fine too (all defaults)
+        p.write_text("overrides:\n  acme:\n")
+        ov._load(force=True)
+        assert ov.for_tenant("acme").max_traces_per_user == 100
+
     def test_hot_reload(self, tmp_path):
         p = tmp_path / "overrides.json"
         p.write_text(json.dumps({"overrides": {}}))

@@ -88,6 +88,9 @@ VALID = [
     '{ kind = consumer }',
     '{ kind = unspecified }',
     '{ childCount = 0 }',
+    '{ status = 2 }',   # status/kind are small ints; numeric literals compare
+    '{ kind != 2 }',
+    '{ status > 1 }',
     '{ 1 = childCount }',
     '{ parent = nil }',
     # --- mixed/nested field expressions ---
