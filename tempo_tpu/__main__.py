@@ -78,6 +78,9 @@ def main(argv=None) -> int:
     log.info("tempo-tpu up: target=%s listening on %s", cfg.target, server.url)
 
     stop = threading.Event()
+    # lets the HTTP /shutdown handler terminate this process after its
+    # drain (reference ShutdownHandler semantics)
+    app.on_shutdown_request = stop.set
 
     def handle(sig, frame):
         log.info("signal %s: shutting down", sig)

@@ -292,6 +292,34 @@ class _Handler(BaseHTTPRequestHandler):
             return 200
 
         # admin
+        if path == "/flush":
+            # cut + drain everything now (reference FlushHandler,
+            # modules/ingester/flush.go:170 'no jitter if immediate')
+            if not app.ingesters:
+                raise RoleUnavailable("no ingester in this process")
+            for ing in app.ingesters.values():
+                ing.flush_all()
+            self._send(204, b"", "text/plain; charset=utf-8")
+            return 204
+        if path == "/shutdown":
+            # graceful drain then terminate (reference ShutdownHandler,
+            # modules/ingester/flush.go:88-114: flush, exit ring, stop)
+            if not app.ingesters:
+                raise RoleUnavailable("no ingester in this process")
+            for ing in app.ingesters.values():
+                ing.flush_all()
+            req = getattr(app, "on_shutdown_request", None)
+            if req is None:
+                # embedded server (tests, library use): nobody owns the
+                # process lifecycle, so acking termination would be a lie
+                self._send(200, b"flushed; no process manager, not terminating",
+                           "text/plain; charset=utf-8")
+                return 200
+            # response goes out BEFORE the stop fires so the client
+            # reliably sees the ack rather than a reset mid-write
+            self._send(200, b"shutdown job acknowledged", "text/plain; charset=utf-8")
+            req()
+            return 200
         if path == "/ready":
             self._send(200, b"ready", "text/plain; charset=utf-8")
             return 200
@@ -437,6 +465,8 @@ _ENDPOINTS = [
     "GET /status/profile",
     "GET /status/usage-stats",
     "GET /status/runtime_config",
+    "GET /flush",
+    "GET /shutdown",
 ]
 
 

@@ -82,11 +82,14 @@ def _accum_finish(hp: "sketch.HLLPlan"):
 class DeviceSketchAccumulator:
     """Single-device analog of the sharded compactor's sketch plane
     (compactor._ShardedTileMerger): bloom words + HLL registers live ON
-    DEVICE across merged batches, and each batch's trace IDs stream up
-    asynchronously while the host encodes that batch's columns — so the
-    block writer's final fetch pays one small D2H instead of shipping
-    all IDs and building everything in a blocking end-of-job dispatch
-    (measured ~0.19s of a ~1.0s job through the axon tunnel, PERF.md).
+    DEVICE across merged batches. Buffered IDs ship asynchronously every
+    _FLUSH_IDS traces, overlapping the host's column encode, so for
+    production-sized jobs the block writer's final fetch pays one small
+    D2H instead of shipping all IDs and building everything in a
+    blocking end-of-job dispatch (measured ~0.19s of a ~1.0s job through
+    the axon tunnel, PERF.md). Jobs under _FLUSH_IDS traces take a
+    single dispatch at finish() — same cost as the unbuffered path, and
+    far below the padding such small inputs would otherwise waste.
 
     The bloom plan is sized from the bucketed SUM of input object counts
     — an upper bound on output traces, since compaction only dedupes —
@@ -105,12 +108,28 @@ class DeviceSketchAccumulator:
         self._words = jnp.zeros((self.plan.n_shards, self.plan.words_per_shard), jnp.uint32)
         self._regs = sketch.hll_init(self.hp)
         self._step = _accum_step(self.plan, self.hp)
+        self._pending: list[np.ndarray] = []
+        self._n_pending = 0
+
+    # ids buffered host-side until one dispatch is worth its padding +
+    # tunnel message (merged batches carry ~1k traces each; dispatching
+    # every batch wastes bucket padding and queue occupancy)
+    _FLUSH_IDS = 8192
 
     def update(self, batch: SpanBatch) -> None:
         if batch.num_spans == 0:
             return
         firsts, _ = batch.trace_boundaries()
-        ids = batch.cols["trace_id"][firsts]
+        self._pending.append(batch.cols["trace_id"][firsts])
+        self._n_pending += len(firsts)
+        if self._n_pending >= self._FLUSH_IDS:
+            self._flush()
+
+    def _flush(self) -> None:
+        if not self._pending:
+            return
+        ids = self._pending[0] if len(self._pending) == 1 else np.concatenate(self._pending)
+        self._pending, self._n_pending = [], 0
         ids_p, valid = _pad_ids(ids, self._bucket(len(ids)))
         # async dispatch: no sync here — the donated accumulators stay on
         # device and the host goes straight back to encoding columns
@@ -119,6 +138,7 @@ class DeviceSketchAccumulator:
         )
 
     def finish(self) -> dict:
+        self._flush()
         packed = np.asarray(_accum_finish(self.hp)(self._words, self._regs))
         words, est = _unpack_sketch(packed, self.plan)
         return {"bloom_plan": self.plan, "bloom_words": words, "est_distinct": est}
@@ -204,9 +224,9 @@ def write_block(
         return None
 
     if sketches is not None:
-        # index + dictionary writes first: the device is still draining
-        # the last async sketch update, so every host-side byte written
-        # here is overlap for free
+        # index + dictionary writes first: when the device is still
+        # draining async sketch updates (large jobs), every host-side
+        # byte written here is overlap for free
         backend.write_named(meta, ColumnIndexName, index.to_bytes())
         backend.write_named(meta, DictionaryName, fmt.serialize_dictionary(dictionary))
         sk = sketches()

@@ -101,6 +101,32 @@ def _post(url, body, content_type, headers=None):
 
 
 class TestServer:
+    def test_flush_and_shutdown_handlers(self, served_app):
+        """/flush drains live traces to the backend; /shutdown drains and
+        fires the process-stop callback (reference FlushHandler +
+        ShutdownHandler, modules/ingester/flush.go:88-170)."""
+        import threading
+
+        app, server = served_app
+        app.push_traces([make_trace(seed=11, n_spans=3)])
+        status, body, _ = _get(f"{server.url}/flush")
+        assert status == 204
+        # after the drain the backend holds at least one complete block
+        assert app.db.blocklist.metas("single-tenant")
+
+        # embedded server: no process manager -> explicit non-termination
+        status, body, _ = _get(f"{server.url}/shutdown")
+        assert status == 200 and b"not terminating" in body
+
+        fired = threading.Event()
+        app.on_shutdown_request = fired.set
+        try:
+            status, body, _ = _get(f"{server.url}/shutdown")
+            assert status == 200 and b"acknowledged" in body
+            assert fired.wait(5)
+        finally:
+            del app.on_shutdown_request
+
     def test_status_usage_stats(self, served_app, tmp_path):
         """/status/usage-stats shows the current report when reporting is
         enabled, and enabled=False otherwise (reference PathUsageStats)."""
