@@ -10,4 +10,11 @@ scans (pkg/parquetquery) -> ops.scan, and adds HLL/count-min sketches for
 cardinality (north star in BASELINE.json).
 """
 
-from tempo_tpu.ops import bloom, hashing, merge, scan, sketch  # noqa: F401
+from tempo_tpu.util.xla_cache import ensure_persistent_cache
+
+# every kernel below is jitted on static plans; persist their compiles
+# across jobs and processes (a sweep's per-level bloom plans otherwise
+# each pay a fresh XLA compile — see util/xla_cache.py)
+ensure_persistent_cache()
+
+from tempo_tpu.ops import bloom, hashing, merge, scan, sketch  # noqa: F401,E402
