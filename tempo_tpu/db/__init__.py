@@ -387,8 +387,9 @@ class TempoDB:
         partials; partials merge across blocks (a trace may straddle
         them) before aggregate filters resolve (traceql/vector.py, the
         columnar analog of vparquet/block_traceql.go's iterator trees).
-        Structural queries (parent.*, childCount, spanset ops, by,
-        select) take the exact object engine.
+        by()/select() ride the vector path too (grouped partials /
+        attached fields); structural queries (parent.*, childCount,
+        spanset ops) take the exact object engine.
 
         stats (optional dict) accumulates per-query observability
         (reference: modules/querier/stats/stats.proto): inspectedBytes /

@@ -12,9 +12,12 @@ Cross-block correctness: a trace's spans may straddle blocks, so block
 evaluation returns per-trace PARTIALS — matched span masks are span-
 local (safe per block), while aggregate inputs (count/sum/min/max) are
 associative and merge across blocks before the final aggregate filter
-(db.traceql_search drives the merge). Queries using structure that is
-not span-local (parent.*, childCount, parent-nil, structural spanset
-ops, by(), select()) raise Unsupported and fall back to the object
+(db.traceql_search drives the merge). by() keeps those partials per
+(trace, materialized group value) and resolves each group's aggregate
+chain at finalize; select() attaches the chosen fields to the retained
+span tuples. Queries using structure that is not span-local (parent.*,
+childCount, structural spanset ops, filters after by()/aggregates,
+coalesce after by()) raise Unsupported and fall back to the object
 engine.
 
 Type model: every field expression evaluates to (kind, values, defined)
@@ -105,6 +108,11 @@ def needed_columns(pipeline: A.Pipeline):
             walk(stage.expr)
         elif isinstance(stage, A.AggregateFilter) and stage.field_expr is not None:
             walk(stage.field_expr)
+        elif isinstance(stage, A.GroupBy):
+            walk(stage.expr)
+        elif isinstance(stage, A.Select):
+            for e in stage.exprs:
+                walk(e)
     return sorted(span_cols), needs_attrs[0]
 
 
@@ -141,6 +149,7 @@ def _validate(pipeline: A.Pipeline):
     if not isinstance(pipeline.stages[0], A.SpansetFilter):
         raise Unsupported("structural spanset ops")
     seen_agg = False
+    seen_by = False
     for stage in pipeline.stages:
         if isinstance(stage, A.SpansetFilter):
             if seen_agg:
@@ -149,6 +158,10 @@ def _validate(pipeline: A.Pipeline):
                 # filter AFTER an aggregate would change what the
                 # aggregate observes — stage order matters there
                 raise Unsupported("filter stage after aggregate filter")
+            if seen_by:
+                # same reason: a filter after by() re-filters each
+                # group, which the one-shot mask cannot express
+                raise Unsupported("filter stage after by()")
             if stage.expr is not None:
                 _validate_expr(stage.expr)
         elif isinstance(stage, A.AggregateFilter):
@@ -156,7 +169,20 @@ def _validate(pipeline: A.Pipeline):
             if stage.field_expr is not None:
                 _validate_expr(stage.field_expr)
         elif isinstance(stage, A.Coalesce):
-            pass
+            if seen_by:
+                # coalesce merges groups back; aggregates after it see
+                # the union again — the keyed-partial model doesn't
+                raise Unsupported("coalesce after by()")
+        elif isinstance(stage, A.GroupBy):
+            if seen_by:
+                raise Unsupported("multiple by() stages")
+            if seen_agg:
+                raise Unsupported("by() after aggregate filter")
+            seen_by = True
+            _validate_expr(stage.expr)
+        elif isinstance(stage, A.Select):
+            for e in stage.exprs:
+                _validate_expr(e)
         else:
             raise Unsupported(f"stage {type(stage).__name__}")
 
@@ -198,6 +224,20 @@ class _Ctx:
     d: object  # Dictionary
     n: int
     _attr_cache: dict = field(default_factory=dict)
+    # stored VT_* per (scope, name), recorded by _compute_attr — the
+    # "num" kind erases int vs float, but select() must render the
+    # stored type (intValue vs doubleValue) like the object engine
+    _attr_vt: dict = field(default_factory=dict)
+
+    def attr_is_int(self, scope: str, name: str) -> bool:
+        if scope == "any":
+            # span wins where defined (same precedence as _eval's merge)
+            for s in ("span", "resource"):
+                vt = self._attr_vt.get((s, name))
+                if vt is not None:
+                    return vt == VT_INT
+            return False
+        return self._attr_vt.get((scope, name)) == VT_INT
 
     def attr_values(self, scope: str, name: str):
         """(kind, values, defined) for an attribute across all spans."""
@@ -219,6 +259,7 @@ class _Ctx:
             return ("str", codes, codes != 0)
         if name == "http.status_code" and scope in ("any", "span"):
             v = self.batch.cols["http_status"].astype(np.float64)
+            self._attr_vt[(scope, name)] = VT_INT
             return ("num", v, v != 0)
         kc = self.d.get(name)
         if kc is None:
@@ -236,6 +277,7 @@ class _Ctx:
         vt = vts[0]
         if not (vts == vt).all():
             raise Unsupported(f"attr {name} has mixed value types in block")
+        self._attr_vt[(scope, name)] = int(vt)
         owners = a["attr_span"][idx]
         defined = np.zeros(self.n, bool)
         defined[owners] = True
@@ -458,6 +500,29 @@ def filter_mask(expr: A.Expr | None, batch, dictionary) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
+def _span_key(s):
+    """(start, span_id_hex): unique per span, so the trailing tuple
+    fields (name, dur, select values) never get compared."""
+    return (s[0], s[1])
+
+
+@dataclass
+class _GroupPartial:
+    """One by()-group of one trace: same associative partials as the
+    trace itself, keyed by the materialized group value."""
+
+    matched: int = 0
+    aggs: list = field(default_factory=list)
+    spans: list = field(default_factory=list)
+
+    def merge(self, other: "_GroupPartial"):
+        self.matched += other.matched
+        for i, (c, t, mn, mx) in enumerate(other.aggs):
+            c0, t0, mn0, mx0 = self.aggs[i]
+            self.aggs[i] = (c0 + c, t0 + t, min(mn0, mn), max(mx0, mx))
+        self.spans = sorted(self.spans + other.spans, key=_span_key)[:MAX_SPANS_PER_RESULT]
+
+
 @dataclass
 class TracePartial:
     trace_id: bytes
@@ -471,7 +536,11 @@ class TracePartial:
     root_name: str = ""
     has_root: bool = False  # root_* comes from a TRUE root span, not the
     # first-span fallback — a real root in a later block must win
-    spans: list = field(default_factory=list)  # (start, span_id_hex, name, dur)
+    spans: list = field(default_factory=list)  # (start, span_id_hex, name, dur[, sel])
+    # by() mode: {group value: _GroupPartial}; group values are
+    # materialized python scalars (dictionary codes resolved), so keys
+    # merge exactly across blocks with different dictionaries
+    groups: dict | None = None
 
     def merge(self, other: "TracePartial"):
         self.matched += other.matched
@@ -487,7 +556,34 @@ class TracePartial:
         # unconditional sorted-union-truncate: both sides are already
         # capped, and the kept set must be the globally earliest spans
         # regardless of block merge order
-        self.spans = sorted(self.spans + other.spans)[:MAX_SPANS_PER_RESULT]
+        self.spans = sorted(self.spans + other.spans, key=_span_key)[:MAX_SPANS_PER_RESULT]
+        if other.groups:
+            if self.groups is None:
+                self.groups = {}
+            for key, g in other.groups.items():
+                mine = self.groups.get(key)
+                if mine is None:
+                    self.groups[key] = g
+                else:
+                    mine.merge(g)
+
+
+def _materialize_keys(kind, vals, defined, d, n):
+    """Per-span python-scalar by() keys (None = undefined), stable
+    across blocks whose dictionaries assign different codes."""
+    out = np.full(n, None, dtype=object)
+    if kind is None:
+        return out
+    idx = np.flatnonzero(defined)
+    if not len(idx):
+        return out
+    if kind == "str":
+        uniq, inv = np.unique(vals[idx], return_inverse=True)
+        strings = np.array([d[int(c)] for c in uniq], dtype=object)
+        out[idx] = strings[inv]
+    else:  # num / bool scalars hash and compare consistently everywhere
+        out[idx] = vals[idx].astype(object)
+    return out
 
 
 def evaluate_batch(pipeline: A.Pipeline, batch, dictionary) -> dict:
@@ -495,7 +591,9 @@ def evaluate_batch(pipeline: A.Pipeline, batch, dictionary) -> dict:
 
     Aggregate filters are NOT applied here — their inputs are collected
     as associative partials and resolved in finalize() after all blocks
-    merged (a trace may straddle blocks)."""
+    merged (a trace may straddle blocks). With a by() stage the partials
+    are kept per (trace, group value); select() fields are attached to
+    the retained span tuples."""
     n = batch.num_spans
     if n == 0:
         return {}
@@ -512,22 +610,34 @@ def evaluate_batch(pipeline: A.Pipeline, batch, dictionary) -> dict:
         # Coalesce: no-op in the flat-mask model
     if not mask.any():
         return {}
+    group_stage = next((s for s in pipeline.stages if isinstance(s, A.GroupBy)), None)
+    select_exprs = [e for s in pipeline.stages if isinstance(s, A.Select) for e in s.exprs]
 
     firsts, seg = batch.trace_boundaries()
     n_traces = len(firsts)
     m_count = np.bincount(seg[mask], minlength=n_traces)
     hit_traces = np.flatnonzero(m_count > 0)
 
-    # aggregate inputs evaluated over MATCHED spans only
+    # aggregate inputs evaluated over MATCHED spans only. Ungrouped:
+    # whole-column bincount partials per trace. Grouped: keep the raw
+    # per-span arrays; the (small) per-group reductions happen in the
+    # assembly loop below.
     agg_parts = []
+    agg_raw = []
     for stage in agg_stages:
-        if stage.agg == "count":
+        if group_stage is None and stage.agg == "count":
             agg_parts.append((m_count, np.zeros(n_traces), None, None))
+            continue
+        if stage.agg == "count":
+            agg_raw.append(("count", None, None))
             continue
         k, v, d = _eval(stage.field_expr, ctx)
         if k != "num":
             v = np.zeros(n, np.float64)
             d = np.zeros(n, bool)
+        if group_stage is not None:
+            agg_raw.append((stage.agg, v, d))
+            continue
         ok = mask & d
         cnt = np.bincount(seg[ok], minlength=n_traces)
         tot = np.bincount(seg[ok], weights=v[ok], minlength=n_traces)
@@ -537,6 +647,27 @@ def evaluate_batch(pipeline: A.Pipeline, batch, dictionary) -> dict:
             np.minimum.at(mn, seg[ok], v[ok])
             np.maximum.at(mx, seg[ok], v[ok])
         agg_parts.append((cnt, tot, mn, mx))
+
+    gkeys = None
+    if group_stage is not None:
+        gk, gv, gd = _eval(group_stage.expr, ctx)
+        gkeys = _materialize_keys(gk, gv, gd, dictionary, n)
+
+    sel_arrays = []
+    if select_exprs:
+        from tempo_tpu.traceql.engine import _select_label
+
+        for e in select_exprs:
+            k, v, d = _eval(e, ctx)
+            if k is not None:
+                if isinstance(e, A.Intrinsic):
+                    is_int = e.name in ("duration", "childCount", "status", "kind")
+                elif isinstance(e, A.Attribute):
+                    # _eval populated the vt cache via attr_values
+                    is_int = ctx.attr_is_int(e.scope, e.name)
+                else:
+                    is_int = False
+                sel_arrays.append((_select_label(e), k, v, d, is_int))
 
     tid = batch.cols["trace_id"]
     starts = batch.cols["start_unix_nano"]
@@ -571,6 +702,35 @@ def evaluate_batch(pipeline: A.Pipeline, batch, dictionary) -> dict:
     # boundaries are a searchsorted over the hit traces
     grp_bounds = np.searchsorted(m_seg, hit_traces)
 
+    def _sel_value(kind, val, is_int):
+        if kind == "str":
+            return dictionary[int(val)]
+        if kind == "bool":
+            return bool(val)
+        # render the STORED type: VT_INT attrs / int intrinsics as ints
+        # (wire intValue), VT_FLOAT as floats (doubleValue) — exactly
+        # what the object engine's eval returns
+        return int(val) if is_int else float(val)
+
+    def _tuple_at(i):
+        """Span tuple for position i into m_rows_all."""
+        row = m_rows_all[i]
+        t = (
+            int(starts[row]),
+            sid_be[i].tobytes().hex(),
+            dictionary[int(names[row])],
+            int(durations[row]),
+        )
+        if sel_arrays:
+            t = t + (
+                tuple(
+                    (lbl, _sel_value(k, v[row], is_int))
+                    for (lbl, k, v, d, is_int) in sel_arrays
+                    if d[row]
+                ),
+            )
+        return t
+
     out = {}
     for j, t in enumerate(hit_traces):
         lo_m = grp_bounds[j]
@@ -591,16 +751,37 @@ def evaluate_batch(pipeline: A.Pipeline, batch, dictionary) -> dict:
             root_service=dictionary[int(service[root])],
             root_name=dictionary[int(names[root])],
             has_root=bool(has_root_arr[t]),
-            spans=[
-                (
-                    int(starts[m_rows_all[i]]),
-                    sid_be[i].tobytes().hex(),
-                    dictionary[int(names[m_rows_all[i]])],
-                    int(durations[m_rows_all[i]]),
-                )
-                for i in sel
-            ],
+            spans=[] if gkeys is not None else [_tuple_at(i) for i in sel],
         )
+        if gkeys is not None:
+            # partials per (trace, group value); small python loop over
+            # this trace's matched rows only
+            pos_by_key: dict = {}
+            for i in range(lo_m, hi_m):
+                pos_by_key.setdefault(gkeys[m_rows_all[i]], []).append(i)
+            p.groups = {}
+            for key, poss in pos_by_key.items():
+                rows_k = m_rows_all[poss]
+                gp = _GroupPartial(matched=len(poss))
+                for (aggname, v, d) in agg_raw:
+                    if aggname == "count":
+                        gp.aggs.append((len(poss), 0.0, np.inf, -np.inf))
+                        continue
+                    ok = rows_k[d[rows_k]]
+                    if len(ok):
+                        vals = v[ok]
+                        gp.aggs.append(
+                            (len(ok), float(vals.sum()), float(vals.min()), float(vals.max()))
+                        )
+                    else:
+                        gp.aggs.append((0, 0.0, np.inf, -np.inf))
+                if len(poss) > MAX_SPANS_PER_RESULT:
+                    order = np.lexsort((sid[rows_k, 1], sid[rows_k, 0], starts[rows_k]))
+                    keep = [poss[k] for k in order[:MAX_SPANS_PER_RESULT]]
+                else:
+                    keep = poss
+                gp.spans = [_tuple_at(i) for i in keep]
+                p.groups[key] = gp
         for (cnt, tot, mn, mx) in agg_parts:
             p.aggs.append(
                 (
@@ -614,46 +795,71 @@ def evaluate_batch(pipeline: A.Pipeline, batch, dictionary) -> dict:
     return out
 
 
+def _aggs_pass(agg_stages, matched: int, aggs: list) -> bool:
+    """Resolve the aggregate-filter chain over merged partials."""
+    ok = matched > 0
+    for stage, (cnt, tot, mn, mx) in zip(agg_stages, aggs):
+        if not ok:
+            break
+        if stage.agg == "count":
+            val = matched
+        elif cnt == 0:
+            return False
+        else:
+            val = {
+                "avg": tot / cnt,
+                "sum": tot,
+                "min": mn,
+                "max": mx,
+            }[stage.agg]
+        r = stage.rhs.value
+        ok = {
+            "=": val == r,
+            "!=": val != r,
+            ">": val > r,
+            ">=": val >= r,
+            "<": val < r,
+            "<=": val <= r,
+        }[stage.op]
+    return ok
+
+
 def finalize(pipeline: A.Pipeline, partials: dict, limit: int = 20,
              start_s: int = 0, end_s: int = 0) -> list:
     """Merged partials -> SpansetResult list (aggregate filters applied,
-    exact trace-level time window enforced)."""
+    exact trace-level time window enforced). In by() mode each group
+    resolves its own aggregate chain; a trace matches if ANY group
+    survives, and its matched spans are the union of surviving groups —
+    the same union the object engine's run_stages produces."""
     from tempo_tpu.traceql.engine import SpansetResult
 
     agg_stages = [s for s in pipeline.stages[1:] if isinstance(s, A.AggregateFilter)]
+    group_mode = any(isinstance(s, A.GroupBy) for s in pipeline.stages)
     results = []
     for p in partials.values():
         if start_s and p.end < start_s * 10**9:
             continue
         if end_s and p.start > end_s * 10**9:
             continue
-        ok = p.matched > 0
-        for stage, (cnt, tot, mn, mx) in zip(agg_stages, p.aggs):
-            if not ok:
-                break
-            if stage.agg == "count":
-                val = p.matched
-            elif cnt == 0:
-                ok = False
-                break
-            else:
-                val = {
-                    "avg": tot / cnt,
-                    "sum": tot,
-                    "min": mn,
-                    "max": mx,
-                }[stage.agg]
-            r = stage.rhs.value
-            ok = {
-                "=": val == r,
-                "!=": val != r,
-                ">": val > r,
-                ">=": val >= r,
-                "<": val < r,
-                "<=": val <= r,
-            }[stage.op]
-        if not ok:
-            continue
+        if group_mode:
+            matched_val = 0
+            spans: list = []
+            for g in (p.groups or {}).values():
+                if _aggs_pass(agg_stages, g.matched, g.aggs):
+                    matched_val += g.matched
+                    spans.extend(g.spans)
+            if matched_val == 0:
+                continue
+        else:
+            if not _aggs_pass(agg_stages, p.matched, p.aggs):
+                continue
+            matched_val = p.matched
+            spans = p.spans
+        kept = sorted(spans, key=_span_key)[:MAX_SPANS_PER_RESULT]
+        span_attrs = {}
+        for s in kept:
+            if len(s) > 4 and s[4]:
+                span_attrs[bytes.fromhex(s[1])] = dict(s[4])
         results.append(
             SpansetResult(
                 trace_id_hex=p.trace_id.hex(),
@@ -661,8 +867,9 @@ def finalize(pipeline: A.Pipeline, partials: dict, limit: int = 20,
                 root_trace_name=p.root_name,
                 start_time_unix_nano=p.start,
                 duration_ms=(p.end - p.start) // 10**6,
-                spans=[_VSpan(*s) for s in sorted(p.spans)[:MAX_SPANS_PER_RESULT]],
-                matched_override=p.matched,
+                spans=[_VSpan(*s[:4]) for s in kept],
+                span_attrs=span_attrs,
+                matched_override=matched_val,
             )
         )
     results.sort(key=lambda r: -r.start_time_unix_nano)

@@ -31,6 +31,14 @@ def ensure_persistent_cache() -> None:
 
     if getattr(jax.config, "jax_compilation_cache_dir", None):
         return  # respect an explicit user setting
+    # accelerator backends only: CPU kernel compiles are cheap, and
+    # XLA:CPU AOT artifacts embed host machine features — reloading them
+    # warns (and can SIGILL) if the feature probe shifts. Decide from
+    # config/env instead of jax.default_backend(), which would
+    # initialize backends during import.
+    plat = (getattr(jax.config, "jax_platforms", None) or os.environ.get("JAX_PLATFORMS") or "")
+    if plat.split(",")[0].strip().lower() == "cpu":
+        return
     path = os.environ.get("TEMPO_TPU_XLA_CACHE_DIR") or os.path.join(
         os.path.expanduser("~"), ".cache", "tempo_tpu", "xla"
     )

@@ -453,10 +453,86 @@ class TestVectorObjectParity:
         assert got.root_trace_name == "THEROOT"
         assert got.root_service_name == "svc-root"
 
+    def test_by_groups_match_object_engine(self):
+        t = trace_fixture()
+        db = self._db_with([t])
+        # group by name: every group has count 1 -> count() > 1 drops all
+        assert db.traceql_search("t", "{} | by(name) | count() > 1", limit=0) == []
+        # group by status: two kind-2 spans (root+grand share status 0)
+        self._check(db, [t], "{} | by(status) | count() > 1")
+        # group by attr; spans without .level form their own (None) group
+        self._check(db, [t], "{} | by(.level) | count() > 1")
+        self._check(db, [t], "{} | by(.region) | count() = 1")
+        # grouped non-count aggregates
+        self._check(db, [t], "{} | by(status) | avg(duration) > 50ms")
+        self._check(db, [t], "{ status != error } | by(name) | max(duration) >= 100ms")
+        # by + arithmetic key
+        self._check(db, [t], "{} | by(1 + .level) | count() = 1")
+
+    def test_by_groups_merge_across_blocks(self):
+        tid = b"\x31" * 16
+        mk = lambda sid, name, dur: tr.Trace(
+            trace_id=tid,
+            batches=[({"service.name": "s"},
+                      [tr.Span(trace_id=tid, span_id=sid, name=name,
+                               parent_span_id=b"\x00" * 8, start_unix_nano=10**18,
+                               duration_nano=dur)])],
+        )
+        db = TempoDB(DBConfig(backend="mock"), raw_backend=MockBackend())
+        # same group value ("op") split across two blocks with different
+        # dictionaries: counts must merge before the aggregate resolves
+        db.write_batch("t", tr.traces_to_batch([mk(b"\x01" * 8, "op", 1000)]).sorted_by_trace())
+        db.write_batch("t", tr.traces_to_batch([mk(b"\x02" * 8, "op", 3000)]).sorted_by_trace())
+        (got,) = db.traceql_search("t", "{} | by(name) | count() = 2", limit=0)
+        assert got.trace_id_hex == tid.hex()
+        assert db.traceql_search("t", "{} | by(name) | count() = 1", limit=0) == []
+
+    def test_select_attaches_fields(self):
+        t = trace_fixture()
+        db = self._db_with([t])
+        self._check(db, [t], '{ name = "child1" } | select(.level, .region)')
+        (got,) = db.traceql_search("t", '{ name = "child1" } | select(.level, .region)', limit=0)
+        (want,) = execute('{ name = "child1" } | select(.level, .region)',
+                          lambda spec, s, e: [t], limit=0)
+        g = {k.hex(): v for k, v in got.span_attrs.items()}
+        w = {k.hex(): v for k, v in want.span_attrs.items()}
+        assert g == w and g  # {'level': 5, 'region': 'eu'} on child1
+        # to_dict carries the attributes through
+        d = got.to_dict()
+        attrs = d["spanSet"]["spans"][0]["attributes"]
+        assert {a["key"] for a in attrs} == {".level", ".region"}
+
+    def test_select_preserves_stored_value_type(self):
+        """A float attr with an integral value must stay doubleValue on
+        both engines; int attrs stay intValue (review finding)."""
+        tid = b"\x41" * 16
+        sp = tr.Span(trace_id=tid, span_id=b"\x01" * 8, name="op",
+                     parent_span_id=b"\x00" * 8, start_unix_nano=10**18,
+                     duration_nano=1000,
+                     attributes={"ratio": 2.0, "retries": 2})
+        t = tr.Trace(trace_id=tid, batches=[({"service.name": "s"}, [sp])])
+        db = self._db_with([t])
+        q = "{} | select(.ratio, .retries)"
+        (got,) = db.traceql_search("t", q, limit=0)
+        (want,) = execute(q, lambda spec, s, e: [t], limit=0)
+        gv = got.span_attrs[sp.span_id]
+        wv = want.span_attrs[sp.span_id]
+        assert gv == wv
+        assert isinstance(gv[".ratio"], float) and isinstance(gv[".retries"], int)
+        d = got.to_dict()["spanSet"]["spans"][0]["attributes"]
+        byk = {a["key"]: a["value"] for a in d}
+        assert "doubleValue" in byk[".ratio"] and "intValue" in byk[".retries"]
+
+    def test_select_intrinsics_and_missing(self):
+        t = trace_fixture()
+        db = self._db_with([t])
+        self._check(db, [t], "{} | select(duration, name)")
+        self._check(db, [t], "{} | select(.does_not_exist)")
+
     def test_object_fallback_reports_bytes(self):
         t = trace_fixture()
         db = self._db_with([t])
         stats = {}
-        db.traceql_search("t", "{} | by(status)", limit=0, stats=stats)  # by() -> object path
+        db.traceql_search("t", "{} | by(status) | coalesce()", limit=0, stats=stats)  # -> object path
         assert stats.get("inspectedBytes", 0) > 0
         assert stats.get("inspectedBlocks", 0) >= 1
