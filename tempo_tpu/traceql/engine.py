@@ -231,9 +231,18 @@ def _to_result(trace, matched, ctx, select_exprs=()) -> SpansetResult:
     end = max(s.end_unix_nano for s in spans)
     roots = [s for s in spans if s.parent_span_id == b"\x00" * 8]
     root = roots[0] if roots else spans[0]
+    # same retention cap + ordering rule as the vector path
+    # (vector.MAX_SPANS_PER_RESULT): earliest by (start, span_id), true
+    # matched count carried separately
+    from tempo_tpu.traceql.vector import MAX_SPANS_PER_RESULT
+
+    kept = sorted(matched, key=lambda s: (s.start_unix_nano, s.span_id))
     attrs = {}
     if select_exprs:
-        for s in matched:
+        # only the KEPT spans render (to_dict shows spans[:cap]), so
+        # attach select() fields to exactly those — same invariant as
+        # the vector path, which never materializes attrs it won't emit
+        for s in kept[:MAX_SPANS_PER_RESULT]:
             vals = {}
             for e in select_exprs:
                 v = e.eval(s, ctx)
@@ -241,12 +250,6 @@ def _to_result(trace, matched, ctx, select_exprs=()) -> SpansetResult:
                     vals[_select_label(e)] = v
             if vals:
                 attrs[s.span_id] = vals
-    # same retention cap + ordering rule as the vector path
-    # (vector.MAX_SPANS_PER_RESULT): earliest by (start, span_id), true
-    # matched count carried separately
-    from tempo_tpu.traceql.vector import MAX_SPANS_PER_RESULT
-
-    kept = sorted(matched, key=lambda s: (s.start_unix_nano, s.span_id))
     return SpansetResult(
         trace_id_hex=trace.trace_id.hex(),
         root_service_name=ctx.resource_of(root).get("service.name", ""),

@@ -506,6 +506,20 @@ def _span_key(s):
     return (s[0], s[1])
 
 
+def _merge_aggs(mine: list, other: list) -> None:
+    """Fold other's (count, total, min, max) partials into mine."""
+    for i, (c, t, mn, mx) in enumerate(other):
+        c0, t0, mn0, mx0 = mine[i]
+        mine[i] = (c0 + c, t0 + t, min(mn0, mn), max(mx0, mx))
+
+
+def _merge_spans(a: list, b: list) -> list:
+    """Sorted-union-truncate: both sides are already capped, and the
+    kept set must be the globally earliest spans regardless of block
+    merge order."""
+    return sorted(a + b, key=_span_key)[:MAX_SPANS_PER_RESULT]
+
+
 @dataclass
 class _GroupPartial:
     """One by()-group of one trace: same associative partials as the
@@ -517,10 +531,8 @@ class _GroupPartial:
 
     def merge(self, other: "_GroupPartial"):
         self.matched += other.matched
-        for i, (c, t, mn, mx) in enumerate(other.aggs):
-            c0, t0, mn0, mx0 = self.aggs[i]
-            self.aggs[i] = (c0 + c, t0 + t, min(mn0, mn), max(mx0, mx))
-        self.spans = sorted(self.spans + other.spans, key=_span_key)[:MAX_SPANS_PER_RESULT]
+        _merge_aggs(self.aggs, other.aggs)
+        self.spans = _merge_spans(self.spans, other.spans)
 
 
 @dataclass
@@ -544,19 +556,14 @@ class TracePartial:
 
     def merge(self, other: "TracePartial"):
         self.matched += other.matched
-        for i, (c, t, mn, mx) in enumerate(other.aggs):
-            c0, t0, mn0, mx0 = self.aggs[i]
-            self.aggs[i] = (c0 + c, t0 + t, min(mn0, mn), max(mx0, mx))
+        _merge_aggs(self.aggs, other.aggs)
         self.start = min(self.start, other.start)
         self.end = max(self.end, other.end)
         if other.has_root and not self.has_root:
             self.root_service = other.root_service
             self.root_name = other.root_name
             self.has_root = True
-        # unconditional sorted-union-truncate: both sides are already
-        # capped, and the kept set must be the globally earliest spans
-        # regardless of block merge order
-        self.spans = sorted(self.spans + other.spans, key=_span_key)[:MAX_SPANS_PER_RESULT]
+        self.spans = _merge_spans(self.spans, other.spans)
         if other.groups:
             if self.groups is None:
                 self.groups = {}
@@ -663,8 +670,20 @@ def evaluate_batch(pipeline: A.Pipeline, batch, dictionary) -> dict:
                 if isinstance(e, A.Intrinsic):
                     is_int = e.name in ("duration", "childCount", "status", "kind")
                 elif isinstance(e, A.Attribute):
-                    # _eval populated the vt cache via attr_values
-                    is_int = ctx.attr_is_int(e.scope, e.name)
+                    # _eval populated the vt cache via attr_values. An
+                    # "any"-scope attr can mix VT_INT and VT_FLOAT across
+                    # scopes (both kind "num"): the flag must then be
+                    # per span, following _eval's span-wins fill.
+                    if e.scope == "any":
+                        vt_s = ctx._attr_vt.get(("span", e.name))
+                        vt_r = ctx._attr_vt.get(("resource", e.name))
+                        if vt_s is not None and vt_r is not None and vt_s != vt_r:
+                            _, _, ds = ctx.attr_values("span", e.name)
+                            is_int = np.where(ds, vt_s == VT_INT, vt_r == VT_INT)
+                        else:
+                            is_int = ctx.attr_is_int(e.scope, e.name)
+                    else:
+                        is_int = ctx.attr_is_int(e.scope, e.name)
                 else:
                     is_int = False
                 sel_arrays.append((_select_label(e), k, v, d, is_int))
@@ -724,7 +743,13 @@ def evaluate_batch(pipeline: A.Pipeline, batch, dictionary) -> dict:
         if sel_arrays:
             t = t + (
                 tuple(
-                    (lbl, _sel_value(k, v[row], is_int))
+                    (
+                        lbl,
+                        _sel_value(
+                            k, v[row],
+                            bool(is_int[row]) if isinstance(is_int, np.ndarray) else is_int,
+                        ),
+                    )
                     for (lbl, k, v, d, is_int) in sel_arrays
                     if d[row]
                 ),
@@ -735,7 +760,9 @@ def evaluate_batch(pipeline: A.Pipeline, batch, dictionary) -> dict:
     for j, t in enumerate(hit_traces):
         lo_m = grp_bounds[j]
         hi_m = grp_bounds[j + 1] if j + 1 < len(hit_traces) else len(m_rows_all)
-        if hi_m - lo_m > MAX_SPANS_PER_RESULT:
+        if gkeys is not None:
+            sel = ()  # grouped mode keeps spans per group, not per trace
+        elif hi_m - lo_m > MAX_SPANS_PER_RESULT:
             # earliest by (start, span_id) — same rule as the object engine
             rows = m_rows_all[lo_m:hi_m]
             key = np.lexsort((sid[rows, 1], sid[rows, 0], starts[rows]))
@@ -751,7 +778,7 @@ def evaluate_batch(pipeline: A.Pipeline, batch, dictionary) -> dict:
             root_service=dictionary[int(service[root])],
             root_name=dictionary[int(names[root])],
             has_root=bool(has_root_arr[t]),
-            spans=[] if gkeys is not None else [_tuple_at(i) for i in sel],
+            spans=[_tuple_at(i) for i in sel],
         )
         if gkeys is not None:
             # partials per (trace, group value); small python loop over

@@ -35,9 +35,11 @@ def ensure_persistent_cache() -> None:
     # XLA:CPU AOT artifacts embed host machine features — reloading them
     # warns (and can SIGILL) if the feature probe shifts. Decide from
     # config/env instead of jax.default_backend(), which would
-    # initialize backends during import.
+    # initialize backends during import; an UNSET platform means we
+    # cannot rule out CPU, so don't cache (accelerator plugins like the
+    # TPU sitecustomize always set jax_platforms explicitly).
     plat = (getattr(jax.config, "jax_platforms", None) or os.environ.get("JAX_PLATFORMS") or "")
-    if plat.split(",")[0].strip().lower() == "cpu":
+    if plat.split(",")[0].strip().lower() in ("", "cpu"):
         return
     path = os.environ.get("TEMPO_TPU_XLA_CACHE_DIR") or os.path.join(
         os.path.expanduser("~"), ".cache", "tempo_tpu", "xla"
@@ -45,7 +47,7 @@ def ensure_persistent_cache() -> None:
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
-        # small kernels + a fast-compiling CPU backend still benefit:
+        # accelerator compiles through the tunnel cost ~1.2s each:
         # cache everything, however small or quick
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

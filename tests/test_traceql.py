@@ -523,6 +523,44 @@ class TestVectorObjectParity:
         byk = {a["key"]: a["value"] for a in d}
         assert "doubleValue" in byk[".ratio"] and "intValue" in byk[".retries"]
 
+    def test_select_mixed_scope_int_float(self):
+        """An any-scope attr stored VT_FLOAT on one span (span scope) and
+        VT_INT on another (resource scope) must render each span's
+        STORED type on both engines (review finding)."""
+        tid = b"\x42" * 16
+        a = tr.Span(trace_id=tid, span_id=b"\x01" * 8, name="a",
+                    parent_span_id=b"\x00" * 8, start_unix_nano=10**18,
+                    duration_nano=1000, attributes={"x": 1.5})
+        b = tr.Span(trace_id=tid, span_id=b"\x02" * 8, name="b",
+                    parent_span_id=b"\x00" * 8, start_unix_nano=10**18,
+                    duration_nano=1000)
+        t = tr.Trace(trace_id=tid, batches=[({"service.name": "s", "x": 2}, [a, b])])
+        db = self._db_with([t])
+        q = "{} | select(.x)"
+        (got,) = db.traceql_search("t", q, limit=0)
+        (want,) = execute(q, lambda spec, s, e: [t], limit=0)
+        assert got.span_attrs == want.span_attrs
+        assert isinstance(got.span_attrs[a.span_id][".x"], float)
+        assert isinstance(got.span_attrs[b.span_id][".x"], int)
+
+    def test_select_truncation_attrs_match(self):
+        """span_attrs must cover exactly the kept (capped) spans on both
+        engines when matched spans exceed the cap (review finding)."""
+        tid = b"\x43" * 16
+        spans = [tr.Span(trace_id=tid, span_id=i.to_bytes(8, "big"), name="op",
+                         parent_span_id=b"\x00" * 8,
+                         start_unix_nano=10**18 + i, duration_nano=1000,
+                         attributes={"level": i})
+                 for i in range(1, 31)]
+        t = tr.Trace(trace_id=tid, batches=[({"service.name": "s"}, spans)])
+        db = self._db_with([t])
+        q = "{} | select(.level)"
+        (got,) = db.traceql_search("t", q, limit=0)
+        (want,) = execute(q, lambda spec, s, e: [t], limit=0)
+        assert got.span_attrs == want.span_attrs
+        assert len(got.span_attrs) == 20  # the kept spans only
+        assert got.matched_override == want.matched_override == 30
+
     def test_select_intrinsics_and_missing(self):
         t = trace_fixture()
         db = self._db_with([t])
@@ -536,3 +574,115 @@ class TestVectorObjectParity:
         db.traceql_search("t", "{} | by(status) | coalesce()", limit=0, stats=stats)  # -> object path
         assert stats.get("inspectedBytes", 0) > 0
         assert stats.get("inspectedBlocks", 0) >= 1
+
+
+class TestVectorObjectFuzz:
+    """Seeded differential fuzz: random supported queries over random
+    traces (split across two blocks) must produce identical results on
+    the vector path and the object engine (reference analog: the
+    table-driven fetch conformance of vparquet/block_traceql_test.go)."""
+
+    _FILTERS = [
+        "{}",
+        '{ name = "op3" }',
+        '{ name =~ "op[12]" }',
+        "{ duration > 40ms }",
+        "{ status = error }",
+        "{ kind = server }",
+        "{ .level > 2 }",
+        '{ .region = "eu" }',
+        "{ .flag = true }",
+        "{ .ratio >= 1.5 }",
+        '{ status != error && .level <= 4 }',
+        '{ name = "op1" || .region = "ap" }',
+        "{ parent = nil }",
+        "{ !(.level = 3) }",
+    ]
+    _BYS = [None, "by(name)", "by(status)", "by(.region)", "by(.level)", "by(1 + .level)"]
+    _AGGS = [None, "count() > 1", "count() = 2", "avg(duration) > 50ms",
+             "max(.level) >= 3", "sum(.ratio) < 4", "min(duration) <= 80ms"]
+    _SELECTS = [None, "select(name, duration)", "select(.level, .region, .ratio)"]
+
+    def _random_traces(self, rng, n_traces=12):
+        regions = ["eu", "us", "ap"]
+        traces = []
+        for i in range(n_traces):
+            tid = rng.getrandbits(128).to_bytes(16, "big")
+            spans = []
+            n_spans = rng.randint(1, 6)
+            for j in range(n_spans):
+                attrs = {}
+                if rng.random() < 0.7:
+                    attrs["level"] = rng.randint(0, 5)
+                if rng.random() < 0.5:
+                    attrs["region"] = rng.choice(regions)
+                if rng.random() < 0.3:
+                    attrs["flag"] = rng.random() < 0.5
+                if rng.random() < 0.4:
+                    attrs["ratio"] = rng.choice([0.5, 1.5, 2.0, 3.25])
+                spans.append(tr.Span(
+                    trace_id=tid,
+                    span_id=rng.getrandbits(64).to_bytes(8, "big"),
+                    name=f"op{rng.randint(1, 4)}",
+                    parent_span_id=(b"\x00" * 8 if j == 0 else spans[0].span_id),
+                    start_unix_nano=10**18 + rng.randint(0, 10**9),
+                    duration_nano=rng.choice([10, 30, 50, 80, 120]) * 10**6,
+                    status_code=rng.choice([0, 0, 1, 2]),
+                    kind=rng.choice([1, 2, 3]),
+                    attributes=attrs,
+                ))
+            traces.append(tr.Trace(
+                trace_id=tid,
+                batches=[({"service.name": f"svc{i % 3}"}, spans)],
+            ))
+        return traces
+
+    def test_fuzz_parity(self):
+        import random
+
+        from tempo_tpu.traceql import vector
+
+        rng = random.Random(1234)
+        checked = vectorized = 0
+        for round_i in range(40):
+            traces = self._random_traces(rng)
+            db = TempoDB(DBConfig(backend="mock"), raw_backend=MockBackend())
+            # split each trace's spans across two blocks (merge coverage)
+            half_a, half_b = [], []
+            for t in traces:
+                res, spans = t.batches[0]
+                k = len(spans) // 2
+                if k:
+                    half_a.append(tr.Trace(trace_id=t.trace_id, batches=[(res, spans[:k])]))
+                half_b.append(tr.Trace(trace_id=t.trace_id, batches=[(res, spans[k:])]))
+            db.write_batch("t", tr.traces_to_batch(half_a).sorted_by_trace())
+            db.write_batch("t", tr.traces_to_batch(half_b).sorted_by_trace())
+
+            for _ in range(8):
+                parts = [rng.choice(self._FILTERS)]
+                by = rng.choice(self._BYS)
+                if by:
+                    parts.append(by)
+                agg = rng.choice(self._AGGS)
+                if agg:
+                    parts.append(agg)
+                sel = rng.choice(self._SELECTS)
+                if sel:
+                    parts.append(sel)
+                q = " | ".join(parts)
+                pipeline = parse(q)
+                if vector.supports(pipeline):
+                    vectorized += 1
+                got = db.traceql_search("t", q, limit=0)
+                want = execute(q, lambda spec, s, e, _t=traces: _t, limit=0)
+                gm = {r.trace_id_hex: (set(s.span_id for s in r.spans),
+                                       r.matched_override if r.matched_override >= 0 else len(r.spans),
+                                       {k.hex(): v for k, v in r.span_attrs.items()})
+                      for r in got}
+                wm = {r.trace_id_hex: (set(s.span_id for s in r.spans),
+                                      r.matched_override if r.matched_override >= 0 else len(r.spans),
+                                      {k.hex(): v for k, v in r.span_attrs.items()})
+                      for r in want}
+                assert gm == wm, f"query {q!r} diverged (round {round_i})"
+                checked += 1
+        assert checked == 320 and vectorized > 200, (checked, vectorized)
