@@ -4,6 +4,9 @@ Prints ONE JSON line:
   {"metric": "blocks_compacted_per_sec_per_chip", "value": N,
    "unit": "blocks/s/chip", "vs_baseline": R, "reps": K,
    "spread_pct": S}
+On watchdog abort (hung device/tunnel) the single line is instead
+  {"metric": ..., "value": null, "vs_baseline": null, "error": "..."}
+with exit code 1 — reps/spread_pct are absent on failure.
 
 Measures the ENGINE's real compaction path (VtpuCompactor.compact):
 ranged reads + column decode -> streaming k-way merge/dedupe -> column
@@ -237,15 +240,42 @@ def _watchdog(seconds: float):
     so a daemon timer dumps a diagnostic and exits nonzero."""
     import threading
 
+    lock = threading.Lock()
+    finished = threading.Event()
+
     def fire():
-        print(f"[bench] WATCHDOG: no result after {seconds:.0f}s — device "
-              f"init or a rep is hung (tunnel down?); aborting", file=sys.stderr)
-        sys.stderr.flush()
-        os._exit(1)
+        # serialized against finish(): if the run completed while this
+        # callback was starting, the success JSON is the artifact and
+        # this must stay silent (the driver parses the LAST JSON line)
+        with lock:
+            if finished.is_set():
+                return
+            print(f"[bench] WATCHDOG: no result after {seconds:.0f}s — device "
+                  f"init or a rep is hung (tunnel down?); aborting", file=sys.stderr)
+            # an explicit error artifact beats silence: a hung tunnel is
+            # an environment failure, not an engine regression
+            print(json.dumps({
+                "metric": "blocks_compacted_per_sec_per_chip",
+                "value": None,
+                "unit": "blocks/s/chip",
+                "vs_baseline": None,
+                "error": f"watchdog: no result after {seconds:.0f}s (device/tunnel hung)",
+            }), flush=True)
+            sys.stderr.flush()
+            os._exit(1)
 
     t = threading.Timer(seconds, fire)
     t.daemon = True
     t.start()
+
+    def finish():
+        """Mark the run complete; after this returns the watchdog can
+        neither exit the process nor print its error line."""
+        with lock:
+            finished.set()
+        t.cancel()
+
+    t.finish = finish
     return t
 
 
@@ -346,7 +376,7 @@ def main():
             print(f"[bench] WARNING: {name} arm bloom fp {summary['bloom_fp_rate']}", file=sys.stderr)
     print(f"[bench] loadavg after: {_loadavg():.2f}", file=sys.stderr)
 
-    dog.cancel()
+    dog.finish()
     print(json.dumps({
         "metric": "blocks_compacted_per_sec_per_chip",
         "value": round(blocks_per_s / max(n_dev, 1), 3),
