@@ -11,6 +11,7 @@ mark-compacted, blocklist update).
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -31,6 +32,10 @@ compaction_blocks = metrics.counter(
 compaction_objects = metrics.counter(
     "tempodb_compaction_objects_written_total", "Objects (traces) written by compaction"
 )
+compaction_slow_jobs = metrics.counter(
+    "tempodb_compaction_slow_jobs_total",
+    "Compaction jobs still running past the slow-job threshold",
+)
 
 DEFAULT_INPUT_BLOCKS = 2  # reference: tempodb/compactor.go:21-23
 MAX_COMPACTION_RANGE = 4
@@ -45,6 +50,9 @@ class CompactionConfig:
     cycle_s: float = 30.0
     retention_s: float = 14 * 24 * 3600
     compacted_retention_s: float = 3600
+    # a device call through a wedged tunnel cannot be cancelled; make it
+    # at least loudly observable (0 disables)
+    slow_job_warn_s: float = 300.0
 
 
 class TimeWindowBlockSelector:
@@ -159,7 +167,27 @@ class CompactionDriver:
     def compact_blocks(self, tenant: str, group: list[BlockMeta]):
         enc = self.db.encoding_for(group[0].version)
         compactor = enc.new_compactor(self.db.compaction_options())
-        new_metas = compactor.compact(group, tenant, self.db.backend)
+        warn = None
+        warn_s = self.cfg.slow_job_warn_s
+        if warn_s:
+            ids = [m.block_id for m in group]
+
+            def slow():
+                compaction_slow_jobs.inc(tenant=tenant)
+                log.warning(
+                    "compaction job for tenant %s blocks %s still running after %.0fs "
+                    "— wedged device/tunnel or pathological input; the job cannot be "
+                    "cancelled, only observed", tenant, ids, warn_s,
+                )
+
+            warn = threading.Timer(warn_s, slow)
+            warn.daemon = True
+            warn.start()
+        try:
+            new_metas = compactor.compact(group, tenant, self.db.backend)
+        finally:
+            if warn is not None:
+                warn.cancel()
         now = time.time()
         compacted = []
         for m in group:

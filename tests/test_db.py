@@ -135,6 +135,49 @@ class TestCompactionEngine:
         got = db.find("tenant", shared[0].trace_id)
         assert got is not None
 
+    def test_slow_compaction_job_warns(self, tmp_path, caplog, monkeypatch):
+        """A job outliving slow_job_warn_s logs loudly and bumps the
+        counter — the only defense against an uncancellable wedged
+        device call (PERF.md tunnel pathology). The job is made
+        deterministically slow so the timer always fires first."""
+        import logging
+        import time as _time
+
+        from tempo_tpu.db.compaction import compaction_slow_jobs
+        from tempo_tpu.encoding.vtpu.compactor import VtpuCompactor
+
+        orig = VtpuCompactor.compact
+
+        def slow_compact(self, *a, **k):
+            _time.sleep(0.1)  # >> warn threshold below
+            return orig(self, *a, **k)
+
+        monkeypatch.setattr(VtpuCompactor, "compact", slow_compact)
+        db = TempoDB(DBConfig(
+            backend="local", backend_path=str(tmp_path / "b"),
+            compaction=CompactionConfig(slow_job_warn_s=0.01),
+        ))
+        for b in range(2):
+            db.write_batch("t", synth.make_batch(200, 8, seed=b))
+        db.poll_now()
+        before = compaction_slow_jobs.value(tenant="t")
+        with caplog.at_level(logging.WARNING, logger="tempo_tpu.db.compaction"):
+            assert db.compact_once("t") == 1
+        assert compaction_slow_jobs.value(tenant="t") == before + 1
+        assert "still running" in caplog.text
+        # threshold disabled: no timer at all
+        db2 = TempoDB(DBConfig(
+            backend="local", backend_path=str(tmp_path / "b2"),
+            compaction=CompactionConfig(slow_job_warn_s=0),
+        ))
+        for b in range(2):
+            db2.write_batch("t", synth.make_batch(200, 8, seed=b))
+        db2.poll_now()
+        caplog.clear()
+        with caplog.at_level(logging.WARNING, logger="tempo_tpu.db.compaction"):
+            assert db2.compact_once("t") == 1
+        assert "still running" not in caplog.text
+
     def test_compaction_sweep_many_blocks(self, tmp_path):
         """Mirrors tempodb/compactor_test.go's synthetic multi-block sweep."""
         db = make_db(tmp_path)
