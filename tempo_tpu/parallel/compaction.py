@@ -25,7 +25,7 @@ no collectives crossing it.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
+from functools import lru_cache, partial
 
 import numpy as np
 
@@ -101,8 +101,14 @@ def local_compaction_step(tids, sids, valid, plans: CompactionPlans, axis: str |
     }
 
 
+@lru_cache(maxsize=32)
 def make_sharded_compactor(mesh, plans: CompactionPlans):
     """Jitted shard_map over (W, R, N, ...) stacked shard inputs.
+
+    Memoized on (mesh, plans) — jax.Mesh hashes by value and the plans
+    are frozen — because a fresh closure per compaction job would start
+    an empty jit cache and re-pay full XLA compiles every job (measured
+    ~4.2s of a 6.4s warm mesh job before memoization).
 
     Outputs: per-shard merge plans sharded as inputs; sketches and totals
     replicated across the range axis (one copy per window).

@@ -19,6 +19,7 @@ makes jobs uniform).
 from __future__ import annotations
 
 import numpy as np
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +34,7 @@ from tempo_tpu.ops import bloom
 from tempo_tpu.parallel.mesh import RANGE_AXIS, WINDOW_AXIS
 
 
+@lru_cache(maxsize=32)
 def make_sharded_tag_scan(mesh, n_cols: int, max_codes: int = 64):
     """Jitted sharded equality-set scan.
 
@@ -77,6 +79,7 @@ def make_sharded_tag_scan(mesh, n_cols: int, max_codes: int = 64):
     )
 
 
+@lru_cache(maxsize=32)
 def make_sharded_bloom_test(mesh, p: bloom.BloomPlan):
     """Vmapped bloom membership test over mesh-sharded block ranges
     (P3: 'bloom tests vmapped' — one query ID against many blocks'
@@ -109,6 +112,7 @@ def make_sharded_bloom_test(mesh, p: bloom.BloomPlan):
     )
 
 
+@lru_cache(maxsize=32)
 def make_sharded_tag_scan_per_shard(mesh, n_cols: int, max_codes: int = 64):
     """Like make_sharded_tag_scan, but the accepted code sets are
     SHARDED with the rows: codes (W, R, C, K). Needed when shards come
@@ -169,7 +173,6 @@ class MeshSearcher:
         self.bucket_for = bucket_for
         self.max_codes = max_codes
         self.max_cache_bytes = max_cache_bytes
-        self._scans: dict = {}  # n_cols -> jitted per-shard scan
         self._cache: OrderedDict = OrderedDict()  # (block, rg_i, col) -> np col
         self._cache_bytes = 0
         # one searcher serves every request thread of the HTTP server —
@@ -204,11 +207,8 @@ class MeshSearcher:
         return col
 
     def _scan(self, n_cols: int):
-        fn = self._scans.get(n_cols)
-        if fn is None:
-            fn = make_sharded_tag_scan_per_shard(self.mesh, n_cols, self.max_codes)
-            self._scans[n_cols] = fn
-        return fn
+        # memoized at the factory (lru_cache on mesh/n_cols/max_codes)
+        return make_sharded_tag_scan_per_shard(self.mesh, n_cols, self.max_codes)
 
     # -- search ----------------------------------------------------------
     def search_blocks(self, blocks, req) -> "object":
