@@ -298,3 +298,88 @@ class TestAdvisorRegressions:
         batches = list(comp._stream_merge(streams, out_dict, None))
         total = sum(b.num_spans for b in batches)
         assert total == 12 * 4, f"merge truncated: {total} of {12*4} spans"
+
+
+class TestMergePathFuzz:
+    """Seeded randomized parity across every merge path: numpy mirror,
+    native C++ k-way, device lexsort, and the 8-shard mesh must produce
+    logically identical output blocks for the same random workload
+    (random dup fractions, divergent duplicate payloads, trace sizes,
+    row-group geometry, 2-4 input blocks)."""
+
+    def _random_job(self, rng, backend, cfg):
+        n_blocks = int(rng.integers(2, 5))
+        base = synth.make_traces(int(rng.integers(30, 120)), seed=int(rng.integers(1 << 30)),
+                                 spans_per_trace=int(rng.integers(1, 6)))
+        metas = []
+        for b in range(n_blocks):
+            fresh = synth.make_traces(int(rng.integers(10, 80)), seed=int(rng.integers(1 << 30)),
+                                      spans_per_trace=int(rng.integers(1, 6)))
+            # RF-style duplicates from the shared base, some with
+            # divergent payloads (exercises combine, not just dedupe)
+            k = int(rng.integers(0, len(base) // 2 + 1))
+            dups = []
+            for t in base[:k]:
+                if rng.random() < 0.4:
+                    res, spans = t.batches[0]
+                    spans = [
+                        tr.Span(
+                            trace_id=s.trace_id, span_id=s.span_id, name=s.name,
+                            parent_span_id=s.parent_span_id,
+                            start_unix_nano=s.start_unix_nano,
+                            duration_nano=s.duration_nano + int(rng.integers(1, 1000)),
+                            status_code=s.status_code, kind=s.kind,
+                            attributes={**s.attributes, "rf_extra": int(rng.integers(9))},
+                        )
+                        for s in spans
+                    ]
+                    dups.append(tr.Trace(trace_id=t.trace_id, batches=[(res, spans)]))
+                else:
+                    dups.append(t)
+            metas.append(write_block_of(backend, dups + fresh, cfg))
+        return metas
+
+    def _signature(self, backend, meta, cfg):
+        got = read_all_rows(backend, meta, cfg)
+        blk = enc().open_block(meta, backend, cfg)
+        d = blk.dictionary()
+        from tempo_tpu.model.columnar import CODE_COLUMNS, SPAN_COLUMNS, VT_STR
+
+        cols = {}
+        for name in SPAN_COLUMNS:
+            if name in CODE_COLUMNS:
+                cols[name] = [d[int(c)] for c in got.cols[name]]
+            else:
+                cols[name] = got.cols[name].tolist()
+        attrs = sorted(
+            (
+                int(got.attrs["attr_span"][i]),
+                int(got.attrs["attr_scope"][i]),
+                d[int(got.attrs["attr_key"][i])],
+                int(got.attrs["attr_vtype"][i]),
+                d[int(got.attrs["attr_str"][i])]
+                if got.attrs["attr_vtype"][i] == VT_STR
+                else float(got.attrs["attr_num"][i]),
+            )
+            for i in range(got.num_attrs)
+        )
+        return (meta.total_objects, meta.total_spans, cols, attrs)
+
+    def test_all_merge_paths_agree(self, backend):
+        rng = np.random.default_rng(77)
+        cfg = BlockConfig(row_group_spans=128)
+        mesh = compaction_mesh(8)
+        for round_i in range(4):
+            metas = self._random_job(rng, backend, cfg)
+            sigs = {}
+            for label, opts in (
+                ("numpy", CompactionOptions(block_config=cfg, merge_path="numpy")),
+                ("native", CompactionOptions(block_config=cfg, merge_path="native")),
+                ("device", CompactionOptions(block_config=cfg, merge_path="device")),
+                ("mesh", CompactionOptions(block_config=cfg, mesh=mesh)),
+            ):
+                (out,) = VtpuCompactor(opts).compact(list(metas), f"r{round_i}-{label}", backend)
+                sigs[label] = self._signature(backend, out, cfg)
+            base_sig = sigs["numpy"]
+            for label, sig in sigs.items():
+                assert sig == base_sig, f"round {round_i}: path {label} diverged"
