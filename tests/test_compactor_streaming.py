@@ -321,19 +321,21 @@ class TestMergePathFuzz:
             dups = []
             for t in base[:k]:
                 if rng.random() < 0.4:
-                    res, spans = t.batches[0]
-                    spans = [
-                        tr.Span(
-                            trace_id=s.trace_id, span_id=s.span_id, name=s.name,
-                            parent_span_id=s.parent_span_id,
-                            start_unix_nano=s.start_unix_nano,
-                            duration_nano=s.duration_nano + int(rng.integers(1, 1000)),
-                            status_code=s.status_code, kind=s.kind,
-                            attributes={**s.attributes, "rf_extra": int(rng.integers(9))},
-                        )
-                        for s in spans
+                    batches = [
+                        (res, [
+                            tr.Span(
+                                trace_id=s.trace_id, span_id=s.span_id, name=s.name,
+                                parent_span_id=s.parent_span_id,
+                                start_unix_nano=s.start_unix_nano,
+                                duration_nano=s.duration_nano + int(rng.integers(1, 1000)),
+                                status_code=s.status_code, kind=s.kind,
+                                attributes={**s.attributes, "rf_extra": int(rng.integers(9))},
+                            )
+                            for s in spans
+                        ])
+                        for res, spans in t.batches  # ALL batches: multi-service traces too
                     ]
-                    dups.append(tr.Trace(trace_id=t.trace_id, batches=[(res, spans)]))
+                    dups.append(tr.Trace(trace_id=t.trace_id, batches=batches))
                 else:
                     dups.append(t)
             metas.append(write_block_of(backend, dups + fresh, cfg))
