@@ -602,6 +602,16 @@ class TestVectorObjectFuzz:
     _AGGS = [None, "count() > 1", "count() = 2", "avg(duration) > 50ms",
              "max(.level) >= 3", "sum(.ratio) < 4", "min(duration) <= 80ms"]
     _SELECTS = [None, "select(name, duration)", "select(.level, .region, .ratio)"]
+    # structural spanset expressions: always the object engine, so this
+    # arm fuzzes pushdown + cross-block trace reassembly rather than
+    # engine parity
+    _STRUCTURAL = [
+        '{ name = "op1" } && { name = "op2" }',
+        '{ .level > 2 } || { .region = "eu" }',
+        '{ parent = nil } > { duration > 20ms }',
+        '{ name =~ "op." } >> { status = error }',
+        '{ kind = server } ~ { kind = client }',
+    ]
 
     def _random_traces(self, rng, n_traces=12):
         regions = ["eu", "us", "ap"]
@@ -659,7 +669,10 @@ class TestVectorObjectFuzz:
             db.write_batch("t", tr.traces_to_batch(half_b).sorted_by_trace())
 
             for _ in range(8):
-                parts = [rng.choice(self._FILTERS)]
+                if rng.random() < 0.25:
+                    parts = [rng.choice(self._STRUCTURAL)]
+                else:
+                    parts = [rng.choice(self._FILTERS)]
                 by = rng.choice(self._BYS)
                 if by:
                     parts.append(by)
@@ -673,8 +686,18 @@ class TestVectorObjectFuzz:
                 pipeline = parse(q)
                 if vector.supports(pipeline):
                     vectorized += 1
-                got = db.traceql_search("t", q, limit=0)
-                want = execute(q, lambda spec, s, e, _t=traces: _t, limit=0)
+                # occasionally constrain the time window; traces start in
+                # [10**9, 10**9+1] s, so the second window DROPS almost
+                # every trace (sub-second start offsets) while the first
+                # keeps all — both sides of the prune get exercised
+                kw = {}
+                r = rng.random()
+                if r < 0.15:
+                    kw = {"start_s": 10**9 - 10, "end_s": 10**9 + 10}
+                elif r < 0.3:
+                    kw = {"start_s": 1, "end_s": 10**9}
+                got = db.traceql_search("t", q, limit=0, **kw)
+                want = execute(q, lambda spec, s, e, _t=traces: _t, limit=0, **kw)
                 gm = {r.trace_id_hex: (set(s.span_id for s in r.spans),
                                        r.matched_override if r.matched_override >= 0 else len(r.spans),
                                        {k.hex(): v for k, v in r.span_attrs.items()})
@@ -685,4 +708,4 @@ class TestVectorObjectFuzz:
                       for r in want}
                 assert gm == wm, f"query {q!r} diverged (round {round_i})"
                 checked += 1
-        assert checked == 320 and vectorized > 200, (checked, vectorized)
+        assert checked == 320 and vectorized > 150, (checked, vectorized)
