@@ -291,6 +291,45 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, b"echo", "text/plain; charset=utf-8")
             return 200
 
+        # ring + membership status pages (reference: GET /{role}/ring and
+        # /memberlist debug pages, docs/tempo api_docs + dskit ring http)
+        if path in ("/ingester/ring", "/distributor/ring", "/compactor/ring",
+                    "/metrics-generator/ring"):
+            if path == "/metrics-generator/ring":
+                ring = app.generator_ring
+            elif path == "/compactor/ring":
+                # the compactor's OWN ring (job-hash sharding), not the
+                # data ring — None when compaction runs unsharded
+                ring = getattr(app.compactor, "ring", None) if app.compactor else None
+            else:
+                ring = app.ring
+            if ring is None:
+                self._send_json(200, {"enabled": False})
+                return 200
+            now = time.time()
+            self._send_json(200, {
+                "enabled": True,
+                "replication_factor": ring.replication_factor,
+                "heartbeat_timeout_s": ring.heartbeat_timeout_s,
+                "instances": [
+                    {
+                        "id": i.instance_id,
+                        "addr": i.addr,
+                        "state": i.state,
+                        "tokens": len(i.tokens),
+                        "heartbeat_age_s": round(now - i.heartbeat, 1) if i.heartbeat else None,
+                        "healthy": i.healthy(ring.heartbeat_timeout_s, now),
+                    }
+                    for i in sorted(ring.instances(), key=lambda i: i.instance_id)
+                ],
+            })
+            return 200
+        if path == "/memberlist":
+            # KV-store debug view (reference memberlist status page): the
+            # names every ring/seed shares plus their revisions
+            self._send_json(200, {"stores": app.kv_service.summary()})
+            return 200
+
         # admin
         if path == "/flush":
             # cut + drain everything now (reference FlushHandler,
@@ -467,6 +506,11 @@ _ENDPOINTS = [
     "GET /status/runtime_config",
     "GET /flush",
     "GET /shutdown",
+    "GET /ingester/ring",
+    "GET /distributor/ring",
+    "GET /compactor/ring",
+    "GET /metrics-generator/ring",
+    "GET /memberlist",
 ]
 
 

@@ -60,6 +60,21 @@ class KVService:
             rev, data = self._stores.get(name, (0, {}))
             return rev, copy.deepcopy(data)
 
+    def names(self) -> list[str]:
+        """Existing store names (membership debug page)."""
+        with self._cond:
+            return sorted(self._stores)
+
+    def summary(self) -> dict:
+        """{name: {revision, keys}} in one lock acquisition, without
+        copying the values (the /memberlist debug page needs names
+        only — ring stores carry every instance's token lists)."""
+        with self._cond:
+            return {
+                name: {"revision": rev, "keys": sorted(data)}
+                for name, (rev, data) in sorted(self._stores.items())
+            }
+
     def cas(self, name: str, revision: int, data: dict) -> tuple[bool, int]:
         """Store data if revision matches; returns (ok, current revision)."""
         with self._cond:

@@ -101,6 +101,21 @@ def _post(url, body, content_type, headers=None):
 
 
 class TestServer:
+    def test_ring_and_memberlist_status_pages(self, served_app):
+        """Ring membership + KV debug pages (reference GET /{role}/ring
+        and /memberlist, docs/tempo api_docs)."""
+        app, server = served_app
+        status, body, _ = _get(f"{server.url}/ingester/ring")
+        assert status == 200
+        doc = json.loads(body)
+        if doc["enabled"]:
+            assert doc["instances"] and all("healthy" in i for i in doc["instances"])
+        status, body, _ = _get(f"{server.url}/metrics-generator/ring")
+        assert status == 200
+        status, body, _ = _get(f"{server.url}/memberlist")
+        assert status == 200
+        assert "stores" in json.loads(body)
+
     def test_flush_and_shutdown_handlers(self, served_app):
         """/flush drains live traces to the backend; /shutdown drains and
         fires the process-stop callback (reference FlushHandler +
