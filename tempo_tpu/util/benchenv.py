@@ -1,0 +1,75 @@
+"""Shared benchmark-environment guards.
+
+The TPU here is reached through an experimental tunnel that fails two
+ways: jax.devices() hangs indefinitely, or backend init raises
+UNAVAILABLE fast. Round 4 shipped an unparseable bench artifact because
+a fast init failure escaped the watchdog; the accelerator-facing bench
+entry points (bench.py, tools/bench_suite.py) probe device init in a
+throwaway subprocess first and pin JAX_PLATFORMS=cpu when the
+accelerator is unreachable, so a dead tunnel degrades a run instead of
+wedging it. tools/bench_mesh.py needs no probe: it force-pins the CPU
+platform (its virtual 8-device mesh only exists there).
+
+Pinning the env var alone is NOT enough here: the tunnel's sitecustomize
+imports jax and sets jax_platforms at interpreter start, which takes
+precedence over the env var. Callers must also run setup_jax() (or
+equivalent) before first device use.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def probe_accelerator(timeout_s: float = 90.0) -> bool:
+    """True if jax device init succeeds within timeout_s in a subprocess.
+
+    Returns True without probing when the run is already CPU-pinned.
+    Callers that get False should set JAX_PLATFORMS=cpu BEFORE importing
+    jax and tag their output artifact (e.g. "platform": "cpu-fallback").
+    """
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        return True
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"[benchenv] probe: jax.devices() hung >{timeout_s:.0f}s "
+              f"(tunnel down) — falling back to CPU", file=sys.stderr)
+        return False
+    if out.returncode != 0:
+        tail = out.stderr.strip().splitlines()[-1] if out.stderr.strip() else "?"
+        print(f"[benchenv] probe: device init failed ({tail}) — falling back "
+              f"to CPU", file=sys.stderr)
+        return False
+    return True
+
+
+def pin_cpu_if_unreachable(timeout_s: float = 90.0) -> bool:
+    """Probe; on failure pin JAX_PLATFORMS=cpu for this process and its
+    children. Returns True when the run fell back (callers tag artifacts).
+
+    Applies the pin to the live jax config too (setup_jax), because the
+    tunnel's sitecustomize already imported jax and set jax_platforms at
+    interpreter start — the env var alone would be ignored."""
+    if probe_accelerator(timeout_s):
+        return False
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    setup_jax()
+    return True
+
+
+def setup_jax():
+    """Import jax honoring JAX_PLATFORMS even under the tunnel's
+    sitecustomize (which sets jax_platforms at interpreter start,
+    overriding the env var — see tests/conftest.py)."""
+    import jax
+
+    env = os.environ.get("JAX_PLATFORMS")
+    if env:
+        jax.config.update("jax_platforms", env)
+    return jax

@@ -1,0 +1,102 @@
+"""bench.py artifact contract: the driver parses the LAST stdout line as
+JSON no matter how the run dies (round-4 lesson: a fast backend-init
+UNAVAILABLE escaped both the watchdog and the JSON error path and the
+round shipped `parsed: null`).
+
+Covers: probe fallback decisions, the failure artifact on a mid-run
+crash, and partial per-arm times surviving into the artifact.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+from tempo_tpu.util import benchenv  # noqa: E402
+
+
+class _FakeProc:
+    def __init__(self, rc, stderr="", stdout=""):
+        self.returncode = rc
+        self.stderr = stderr
+        self.stdout = stdout
+
+
+def test_probe_timeout_falls_back(monkeypatch):
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+
+    def hang(*a, **k):
+        raise subprocess.TimeoutExpired(cmd=a[0], timeout=k.get("timeout"))
+
+    monkeypatch.setattr(benchenv.subprocess, "run", hang)
+    assert bench._probe_accelerator(0.1) is False
+
+
+def test_probe_init_failure_falls_back(monkeypatch):
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setattr(
+        benchenv.subprocess, "run",
+        lambda *a, **k: _FakeProc(1, stderr="jax.errors.JaxRuntimeError: UNAVAILABLE"))
+    assert bench._probe_accelerator(0.1) is False
+
+
+def test_probe_success(monkeypatch):
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setattr(benchenv.subprocess, "run",
+                        lambda *a, **k: _FakeProc(0, stdout="tpu\n"))
+    assert bench._probe_accelerator(0.1) is True
+
+
+def test_probe_skipped_when_cpu_pinned(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+
+    def explode(*a, **k):  # pragma: no cover - must not be called
+        raise AssertionError("probe subprocess spawned on a CPU-pinned run")
+
+    monkeypatch.setattr(benchenv.subprocess, "run", explode)
+    assert bench._probe_accelerator(0.1) is True
+
+
+def test_midrun_crash_emits_artifact(monkeypatch, capsys):
+    """Any exception after the watchdog starts must still produce one
+    parseable JSON line with value:null + error, and exit nonzero."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setattr(
+        bench, "build_inputs",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("simulated UNAVAILABLE")))
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    with pytest.raises(SystemExit) as ei:
+        bench.main()
+    assert ei.value.code == 1
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    art = json.loads(lines[-1])
+    assert art["value"] is None
+    assert art["vs_baseline"] is None
+    assert "simulated UNAVAILABLE" in art["error"]
+    assert art["metric"] == "blocks_compacted_per_sec_per_chip"
+
+
+def test_partial_times_reach_artifact(monkeypatch, capsys):
+    """A crash mid-way keeps whatever rep times already completed in the
+    failure artifact (the judge can still see the CPU arms)."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+
+    def run_then_die(dog, partial):
+        partial["platform"] = "cpu"
+        partial["cpu_single_times_s"] = [1.25, 1.31]
+        raise RuntimeError("died after 2 reps")
+
+    monkeypatch.setattr(bench, "_run", run_then_die)
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    with pytest.raises(SystemExit):
+        bench.main()
+    art = json.loads([l for l in capsys.readouterr().out.splitlines() if l.strip()][-1])
+    assert art["cpu_single_times_s"] == [1.25, 1.31]
+    assert art["platform"] == "cpu"
+    assert art["value"] is None

@@ -22,8 +22,11 @@ import sys
 import tempfile
 import time
 
-# must be set before jax import to get the virtual mesh
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force the CPU platform: the virtual 8-device mesh only exists there,
+# and the axon accelerator platform can hang device init when the
+# tunnel is down (util/benchenv.py). An explicit JAX_PLATFORMS=tpu in
+# the environment must not re-expose the hang.
+os.environ["JAX_PLATFORMS"] = "cpu"
 if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")

@@ -173,6 +173,17 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("config", choices=["ingest", "sweep", "search", "all"])
     args = ap.parse_args()
+    # dead-tunnel guard: probe device init with a timeout BEFORE any jax
+    # import; a hung tunnel degrades the run to CPU (tagged) instead of
+    # wedging it (same contract as bench.py)
+    import os, sys as _sys
+    _sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from tempo_tpu.util.benchenv import pin_cpu_if_unreachable
+
+    fell_back = pin_cpu_if_unreachable(float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "90")))
+    from tempo_tpu.util.benchenv import setup_jax
+
+    setup_jax()  # honor JAX_PLATFORMS over the sitecustomize preset
     runs = {
         "ingest": [bench_ingest],
         "sweep": [bench_sweep],
@@ -180,7 +191,10 @@ def main():
         "all": [bench_ingest, bench_sweep, bench_search],
     }[args.config]
     for fn in runs:
-        print(json.dumps(fn()))
+        out = fn()
+        if fell_back:
+            out["platform"] = "cpu-fallback"
+        print(json.dumps(out))
 
 
 if __name__ == "__main__":
