@@ -54,6 +54,16 @@ class CompactionOptions:
     # tile merge planner when mesh is None: auto (native C++ k-way when
     # built, else device), native, or device (single-device lexsort)
     merge_path: str = "auto"
+    # where payload columns live during a mesh-sharded merge:
+    #   "host"   — device plans (perm/keep) are fetched per tile and the
+    #              host gathers/encodes columns (default; right for a
+    #              low-bandwidth device attachment),
+    #   "device" — payload lanes are staged to device per tile, gathered
+    #              and combine-resolved ON device inside the shard_map
+    #              step, and come home once per flush (~one bounded D2H
+    #              per output row group, zero per-tile plan fetches) —
+    #              the placement for ICI-attached chips. Requires mesh.
+    payload_plane: str = "host"
 
 
 @dataclass

@@ -162,6 +162,203 @@ def init_sketch_accumulators(mesh, plans: CompactionPlans):
     )
 
 
+# ---------------------------------------------------------------------------
+# device-resident payload plane (CompactionOptions.payload_plane="device")
+# ---------------------------------------------------------------------------
+#
+# The host-payload mesh path (make_sharded_compactor) fetches perm/keep
+# per tile and gathers columns in host numpy — on real ICI-attached
+# chips that per-tile D2H and host gather sit on the critical path
+# (round-4 verdict). This step keeps the ENTIRE payload on device:
+# per tile, each shard merges its rows, resolves combine survivors,
+# gathers the packed payload lanes by the survivor order, and appends
+# the result to a device-resident output buffer. Only when the host
+# flushes (≈ once per output row group) does one packed array come
+# home. Reference bar: the whole hot loop of
+# tempodb/encoding/vparquet/compactor.go:146-188 lives off-host here.
+#
+# Lane layout (all uint32):
+#   input aux lanes (cap, 15):
+#     0-1 parent_span_id, 2-3 start_unix_nano (hi,lo),
+#     4-5 duration_nano (hi,lo), 6 kind|status<<8|http_status<<16,
+#     7 name, 8 service, 9 http_method, 10 http_url,
+#     11 n_attrs, 12-13 attr fingerprint (hi,lo), 14 job ordinal
+#   kept output rows (C, 18): tid(4), sid(2), payload lanes 0-10, ordinal
+#   dropped rows (D, 2): ordinal, local run id (for host attr union)
+
+PAYLOAD_IN_LANES = 15
+PAYLOAD_OUT_LANES = 18
+_CMP_LANES = 14  # lanes compared for combine `differs` (all but ordinal)
+
+
+@lru_cache(maxsize=16)
+def make_payload_compactor(mesh, plans: CompactionPlans):
+    """Jitted shard_map step for the device payload plane.
+
+    Carried per-shard state (donated, device-resident across tiles):
+      kept_buf (W,R,C,18) u32, drop_buf (W,R,D,2) u32,
+      kept_log/drop_log/comb_log (W,R,T) i32, cnts (W,R,3) i32
+      [kept_cnt, drop_cnt, tile_idx]
+    plus the per-window sketch accumulators of make_sharded_compactor.
+
+    jit re-specializes per (cap, C, D, T) shape bucket; the factory is
+    memoized on (mesh, plans) like make_sharded_compactor (a fresh
+    closure per job would re-pay full XLA compiles every job).
+
+    CAPACITY CONTRACT (caller-enforced): each append writes a full
+    cap-row slab at the running cursor, and XLA CLAMPS out-of-bounds
+    dynamic_update_slice starts — an overflowing write would silently
+    corrupt earlier rows instead of erroring. The host merger MUST
+    guarantee, before every dispatch, that kept_cnt + cap <= kept_cap,
+    drop_cnt + cap <= drop_cap, and tile_idx < t_max (it flushes first
+    otherwise; see _DevicePayloadTileMerger in encoding/vtpu/compactor).
+    """
+
+    def shard_step(tids, sids, valid, lanes, kept_buf, drop_buf,
+                   kept_log, drop_log, comb_log, cnts,
+                   bloom_acc, hll_acc, cm_acc):
+        cap = tids.shape[0]
+        plan = merge.merge_spans(tids, sids, valid)
+        perm, keep = plan["perm"], plan["keep"]
+        n_runs = plan["n_rows"]
+        svalid = valid[perm]
+        skeys = jnp.concatenate([tids, sids], axis=1)[perm]
+        slanes = lanes[perm]
+        pos = jnp.arange(cap, dtype=jnp.int32)
+
+        run_id_raw = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        # park invalid rows in segment cap-1: they can only collide with a
+        # real run when every row is valid AND unique, i.e. no invalid
+        # rows exist to collide
+        run_id = jnp.where(svalid, jnp.maximum(run_id_raw, 0), cap - 1)
+
+        # combine `differs`: any member whose payload/nattr/fingerprint
+        # lanes differ from its run's first occurrence
+        firstpos = jnp.maximum(jax.lax.cummax(jnp.where(keep, pos, -1)), 0)
+        cmp = slanes[:, :_CMP_LANES]
+        differs_row = jnp.any(cmp != cmp[firstpos], axis=1) & svalid & ~keep
+        run_differs = jax.ops.segment_max(
+            differs_row.astype(jnp.int32), run_id, num_segments=cap) > 0
+        real_run = pos < n_runs
+        local_comb = jnp.sum((run_differs & real_run).astype(jnp.int32))
+        # the host path picks richest-survivors per TILE (all shards) the
+        # moment any run in the tile differs — mirror that exactly; the
+        # reduction must cross BOTH mesh axes (a tile spans every shard,
+        # windows included)
+        tile_comb = jax.lax.psum(local_comb, (WINDOW_AXIS, RANGE_AXIS))
+
+        # survivor per run: max (duration, n_attrs, sorted position) —
+        # cascaded segment-argmax reproduces the host lexsort tie-break
+        dh, dl, na = slanes[:, 4], slanes[:, 5], slanes[:, 11]
+
+        def segmax(x):
+            return jax.ops.segment_max(x, run_id, num_segments=cap)
+
+        m1 = segmax(jnp.where(svalid, dh, 0))
+        is1 = svalid & (dh == m1[run_id])
+        m2 = segmax(jnp.where(is1, dl, 0))
+        is2 = is1 & (dl == m2[run_id])
+        m3 = segmax(jnp.where(is2, na, 0))
+        is3 = is2 & (na == m3[run_id])
+        surv_pos = segmax(jnp.where(is3, pos, 0).astype(jnp.int32))
+        first_pos = jax.ops.segment_min(
+            jnp.where(svalid, pos, cap).astype(jnp.int32), run_id, num_segments=cap)
+        chosen = jnp.clip(jnp.where(tile_comb > 0, surv_pos, first_pos), 0, cap - 1)
+
+        out_rows = jnp.concatenate(
+            [skeys[chosen], slanes[chosen][:, :11], slanes[chosen][:, 14:15]], axis=1)
+        out_rows = jnp.where(real_run[:, None], out_rows, 0)
+
+        is_surv = svalid & (pos == chosen[run_id])
+        mask_d = svalid & (~is_surv) & run_differs[run_id]
+        n_drop = jnp.sum(mask_d.astype(jnp.int32))
+        d_rows = jnp.stack(
+            [slanes[:, 14], run_id.astype(jnp.uint32)], axis=1)
+        d_rows = merge.compact_by_mask(d_rows, mask_d)
+        d_rows = jnp.where((pos < n_drop)[:, None], d_rows, 0)
+
+        kc, dc, ti = cnts[0], cnts[1], cnts[2]
+        kept_buf = jax.lax.dynamic_update_slice(kept_buf, out_rows, (kc, 0))
+        drop_buf = jax.lax.dynamic_update_slice(drop_buf, d_rows, (dc, 0))
+        kept_log = jax.lax.dynamic_update_slice(kept_log, n_runs[None], (ti,))
+        drop_log = jax.lax.dynamic_update_slice(drop_log, n_drop[None], (ti,))
+        comb_log = jax.lax.dynamic_update_slice(comb_log, local_comb[None], (ti,))
+        cnts = jnp.stack([kc + n_runs, dc + n_drop, ti + 1])
+
+        # sketch plane: identical to local_compaction_step's collectives
+        st = tids[perm]
+        trace_first = merge.first_occurrence_mask(st, svalid) & keep
+        words = bloom.build(st, plans.bloom, valid=trace_first)
+        regs = sketch.hll_update(sketch.hll_init(plans.hll), st, plans.hll,
+                                 valid=trace_first)
+        cm_counts = sketch.cm_update(sketch.cm_init(plans.cm), st, plans.cm,
+                                     valid=keep)
+        words = bloom.psum_merge(words, RANGE_AXIS)
+        regs = jax.lax.pmax(regs, RANGE_AXIS)
+        cm_counts = jax.lax.psum(cm_counts, RANGE_AXIS)
+        return (kept_buf, drop_buf, kept_log, drop_log, comb_log, cnts,
+                words, regs, cm_counts)
+
+    def step(tids, sids, valid, lanes, kept_buf, drop_buf,
+             kept_log, drop_log, comb_log, cnts, bloom_acc, hll_acc, cm_acc):
+        out = shard_step(
+            tids[0, 0], sids[0, 0], valid[0, 0], lanes[0, 0],
+            kept_buf[0, 0], drop_buf[0, 0], kept_log[0, 0], drop_log[0, 0],
+            comb_log[0, 0], cnts[0, 0], bloom_acc[0], hll_acc[0], cm_acc[0])
+        (kept_buf, drop_buf, kept_log, drop_log, comb_log, cnts,
+         words, regs, cm_counts) = out
+        sharded = tuple(x[None, None] for x in
+                        (kept_buf, drop_buf, kept_log, drop_log, comb_log, cnts))
+        accs = (
+            (bloom_acc[0] | words)[None],
+            jnp.maximum(hll_acc[0], regs)[None],
+            (cm_acc[0] + cm_counts)[None],
+        )
+        return sharded, accs
+
+    spec_sh = P(WINDOW_AXIS, RANGE_AXIS)
+    spec_w = P(WINDOW_AXIS)
+    return jax.jit(
+        shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(spec_sh,) * 10 + (spec_w,) * 3,
+            out_specs=((spec_sh,) * 6, (spec_w,) * 3),
+            check_vma=False,
+        ),
+        donate_argnums=tuple(range(4, 13)),
+    )
+
+
+def init_payload_buffers(mesh, kept_cap: int, drop_cap: int, t_max: int):
+    """Zeroed per-shard output buffers for make_payload_compactor."""
+    w = mesh.shape[WINDOW_AXIS]
+    r = mesh.shape[RANGE_AXIS]
+    return (
+        jnp.zeros((w, r, kept_cap, PAYLOAD_OUT_LANES), jnp.uint32),
+        jnp.zeros((w, r, drop_cap, 2), jnp.uint32),
+        jnp.zeros((w, r, t_max), jnp.int32),
+        jnp.zeros((w, r, t_max), jnp.int32),
+        jnp.zeros((w, r, t_max), jnp.int32),
+        jnp.zeros((w, r, 3), jnp.int32),
+    )
+
+
+@jax.jit
+def pack_payload_flush(kept_buf, drop_buf, kept_log, drop_log, comb_log, cnts):
+    """Everything the host needs from a flush as ONE u32 vector, so the
+    flush costs a single D2H fetch (the tunnel round trip dominates
+    small transfers; on ICI-attached chips XLA all-gathers the shards)."""
+    return jnp.concatenate([
+        kept_buf.reshape(-1),
+        drop_buf.reshape(-1),
+        kept_log.astype(jnp.uint32).reshape(-1),
+        drop_log.astype(jnp.uint32).reshape(-1),
+        comb_log.astype(jnp.uint32).reshape(-1),
+        cnts.astype(jnp.uint32).reshape(-1),
+    ])
+
+
 def partition_by_id_range(tids: np.ndarray, sids: np.ndarray, r: int,
                           pad_to: int | None = None, bucket=None):
     """Host-side split of span rows into R uniform trace-ID ranges.

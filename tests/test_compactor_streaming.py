@@ -379,9 +379,108 @@ class TestMergePathFuzz:
                 ("native", CompactionOptions(block_config=cfg, merge_path="native")),
                 ("device", CompactionOptions(block_config=cfg, merge_path="device")),
                 ("mesh", CompactionOptions(block_config=cfg, mesh=mesh)),
+                ("mesh-devpay", CompactionOptions(block_config=cfg, mesh=mesh,
+                                                  payload_plane="device")),
             ):
                 (out,) = VtpuCompactor(opts).compact(list(metas), f"r{round_i}-{label}", backend)
                 sigs[label] = self._signature(backend, out, cfg)
             base_sig = sigs["numpy"]
             for label, sig in sigs.items():
                 assert sig == base_sig, f"round {round_i}: path {label} diverged"
+
+
+class TestDevicePayloadPlane:
+    """payload_plane="device": per-tile column gather/compact happens ON
+    device inside the shard_map step; the host fetches one packed array
+    per flush (~one per output row group) and never fetches per-tile
+    perm/keep plans (round-4 verdict #1)."""
+
+    def _job(self, backend, cfg, seed=21, n=60, overlap=20):
+        traces_a = synth.make_traces(n, seed=seed)
+        traces_b = synth.make_traces(n, seed=seed + 1)[: n - overlap] + traces_a[:overlap]
+        m1 = write_block_of(backend, traces_a, cfg)
+        m2 = write_block_of(backend, traces_b, cfg)
+        return m1, m2
+
+    def _raw_block_bytes(self, backend, meta):
+        import gzip
+
+        from tempo_tpu.backend.base import ColumnIndexName, DataName, DictionaryName
+
+        out = {}
+        for name in (DataName, ColumnIndexName, DictionaryName):
+            raw = backend.read_named(meta.tenant_id, meta.block_id, name)
+            if raw[:2] == b"\x1f\x8b":
+                # gzip envelopes embed a timestamp; compare the content
+                raw = gzip.decompress(raw)
+            out[name] = raw
+        return out
+
+    def test_byte_identical_to_host_payload_path(self, backend):
+        cfg = BlockConfig(row_group_spans=128)
+        m1, m2 = self._job(backend, cfg)
+        mesh = compaction_mesh(8)
+
+        host = VtpuCompactor(CompactionOptions(block_config=cfg, mesh=mesh))
+        (out_h,) = host.compact([m1, m2], "th", backend)
+        dev = VtpuCompactor(CompactionOptions(block_config=cfg, mesh=mesh,
+                                              payload_plane="device"))
+        (out_d,) = dev.compact([m1, m2], "td", backend)
+
+        assert out_d.total_objects == out_h.total_objects
+        assert out_d.total_spans == out_h.total_spans
+        assert out_d.total_records == out_h.total_records  # same rg boundaries
+        raw_h = self._raw_block_bytes(backend, out_h)
+        raw_d = self._raw_block_bytes(backend, out_d)
+        for name in raw_h:
+            assert raw_h[name] == raw_d[name], f"object {name} diverged"
+
+    def test_combine_byte_parity(self, backend):
+        """Divergent RF duplicates (richest-survivor + attr union) must
+        come out byte-identical when resolved on device."""
+        cfg = BlockConfig(row_group_spans=64)
+        helper = TestCombineSemantics()
+        _, m1, m2 = helper._divergent_blocks(backend, cfg)
+        mesh = compaction_mesh(8)
+
+        host = VtpuCompactor(CompactionOptions(block_config=cfg, mesh=mesh))
+        (out_h,) = host.compact([m1, m2], "th", backend)
+        dev = VtpuCompactor(CompactionOptions(block_config=cfg, mesh=mesh,
+                                              payload_plane="device"))
+        (out_d,) = dev.compact([m1, m2], "td", backend)
+
+        assert dev.spans_combined == host.spans_combined == 40
+        raw_h = self._raw_block_bytes(backend, out_h)
+        raw_d = self._raw_block_bytes(backend, out_d)
+        for name in raw_h:
+            assert raw_h[name] == raw_d[name], f"object {name} diverged"
+
+    def test_transfer_budget_and_shard_balance(self, backend):
+        """D2H flushes stay O(output row groups) — zero per-tile plan
+        fetches — and per-shard kept rows stay near N/R."""
+        cfg = BlockConfig(row_group_spans=256)
+        traces_a = synth.make_traces(100, seed=41, spans_per_trace=8)
+        traces_b = synth.make_traces(100, seed=42, spans_per_trace=8)
+        m1 = write_block_of(backend, traces_a, cfg)
+        m2 = write_block_of(backend, traces_b, cfg)
+        mesh = compaction_mesh(8)
+        comp = VtpuCompactor(CompactionOptions(block_config=cfg, mesh=mesh,
+                                               payload_plane="device"))
+        (out,) = comp.compact([m1, m2], "t", backend)
+
+        st = comp.payload_stats
+        assert st is not None
+        n_rg = out.total_records
+        assert st["d2h_flushes"] <= n_rg + 1, (st["d2h_flushes"], n_rg)
+        assert st["kept_rows"] == out.total_spans
+        assert st["tiles"] == st["dispatches"]
+        # uniform synthetic trace IDs: no shard should carry a gross
+        # multiple of the mean (the N/R scaling term of the mesh story)
+        per_shard = st["per_shard_kept"]
+        assert per_shard.sum() == out.total_spans
+        assert per_shard.max() <= 3 * max(per_shard.mean(), 1)
+
+    def test_requires_mesh(self):
+        comp = VtpuCompactor(CompactionOptions(payload_plane="device"))
+        with pytest.raises(ValueError, match="requires a mesh"):
+            comp.compact([object()], "t", None)
