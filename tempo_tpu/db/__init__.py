@@ -19,6 +19,8 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+import numpy as np
+
 from tempo_tpu import encoding as encoding_registry
 from tempo_tpu.backend import TypedBackend, make_raw_backend
 from tempo_tpu.db.blocklist import Blocklist, Poller
@@ -388,8 +390,11 @@ class TempoDB:
         them) before aggregate filters resolve (traceql/vector.py, the
         columnar analog of vparquet/block_traceql.go's iterator trees).
         by()/select() ride the vector path too (grouped partials /
-        attached fields); structural queries (parent.*, childCount,
-        spanset ops) take the exact object engine.
+        attached fields), and structural evaluation (parent.*,
+        childCount, the spanset ops >, >>, ~, &&, ||) runs as
+        parent-span-id joins within trace segments; only filters after
+        by()/aggregates and pipeline-valued spanset operands take the
+        exact object engine.
 
         stats (optional dict) accumulates per-query observability
         (reference: modules/querier/stats/stats.proto): inspectedBytes /
@@ -406,30 +411,51 @@ class TempoDB:
         pipeline = parse(query)
         metas = [m for m in self.blocklist.metas(tenant) if _overlaps(m, start_s, end_s)]
         if vector.supports(pipeline) and all(m.version == "vtpu1" for m in metas):
+            # structural pipelines (spanset ops, parent.*, childCount)
+            # join parent links per batch, which is exact only when each
+            # trace lives wholly in one block; the jobs then also report
+            # every trace id they scanned so straddling is detected
+            # EXACTLY (not guessed from id ranges) and the query re-runs
+            # on the object engine, which sees combined traces
+            structural = vector.needs_whole_traces(pipeline) and len(metas) > 1
+
             def job(meta):
                 blk = self.encoding_for(meta.version).open_block(meta, self.backend, self.cfg.block)
                 local: dict = {}
                 n_traces = 0
+                seen_tids = set()
                 for view, d in blk.iter_eval_views(pipeline, start_s, end_s):
                     firsts, _ = view.trace_boundaries()
                     n_traces += len(firsts)
+                    if structural:
+                        tids = np.ascontiguousarray(
+                            view.cols["trace_id"][firsts]).astype(">u4")
+                        seen_tids.update(t.tobytes() for t in tids)
                     for tid, p in vector.evaluate_batch(pipeline, view, d).items():
                         if tid in local:
                             local[tid].merge(p)
                         else:
                             local[tid] = p
-                return local, blk.bytes_read, n_traces
+                return local, blk.bytes_read, n_traces, seen_tids
 
             results, errors = self.pool.run_jobs([lambda m=m: job(m) for m in metas])
-            if any(isinstance(e, vector.Unsupported) for e in errors):
-                # data-shape bailout (e.g. mixed value types for one attr
-                # key): the object engine below answers exactly
+            straddled = False
+            if structural and not errors:
+                counts: dict = {}
+                for _local, _b, _n, seen in results:
+                    for tid in seen:
+                        counts[tid] = counts.get(tid, 0) + 1
+                straddled = any(c > 1 for c in counts.values())
+            if any(isinstance(e, vector.Unsupported) for e in errors) or straddled:
+                # data-shape bailout (mixed value types for one attr key,
+                # or a trace straddling blocks under a structural query):
+                # the object engine below answers exactly
                 pass
             elif errors:
                 raise errors[0]
             else:
                 partials: dict = {}
-                for local, bytes_read, n_traces in results:
+                for local, bytes_read, n_traces, _seen in results:
                     bump(bytes_=bytes_read, traces=n_traces, blocks=1)
                     for tid, p in local.items():
                         if tid in partials:

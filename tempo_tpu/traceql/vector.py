@@ -15,10 +15,19 @@ associative and merge across blocks before the final aggregate filter
 (db.traceql_search drives the merge). by() keeps those partials per
 (trace, materialized group value) and resolves each group's aggregate
 chain at finalize; select() attaches the chosen fields to the retained
-span tuples. Queries using structure that is not span-local (parent.*,
-childCount, structural spanset ops, filters after by()/aggregates,
-coalesce after by()) raise Unsupported and fall back to the object
-engine.
+span tuples.
+
+Structural evaluation (parent.*, childCount, the spanset ops `>`, `>>`,
+`~`, `&&`, `||`) is vectorized as parent-span-id joins within trace
+segments: span_id/parent_span_id pairs rank-compress to a sorted
+(segment, id) key array, one searchsorted resolves every span's parent
+row, `>>` reachability closes by pointer doubling, and `~` groups by
+(segment, parent-id value). Blocks store whole traces (row groups are
+trace-aligned, fmt.row_group_slices), so the per-batch joins see the
+complete span tree exactly like the reference's per-parquet-row
+evaluation (vparquet/block_traceql.go:375-617). Only filters after
+by()/aggregates, coalesce after by(), and pipeline-valued spanset
+operands raise Unsupported and fall back to the object engine.
 
 Type model: every field expression evaluates to (kind, values, defined)
 with kind in {num, bool, str}; strings are block-dictionary codes, so
@@ -84,10 +93,12 @@ def needed_columns(pipeline: A.Pipeline):
 
     def walk(e):
         if isinstance(e, A.Attribute):
-            served = e.name in _DEDICATED_SCOPES and e.scope in _DEDICATED_SCOPES[e.name]
+            # parent.X reads X from the parent span's span-scoped attrs
+            scope = "span" if e.scope == "parent" else e.scope
+            served = e.name in _DEDICATED_SCOPES and scope in _DEDICATED_SCOPES[e.name]
             if served:
                 span_cols.add(_DEDICATED.get(e.name, "http_status"))
-            if not served or e.scope == "any":
+            if not served or scope == "any":
                 # attr-table lookup: unserved scopes always; "any" also
                 # probes the table for the scope the dedicated column
                 # does not cover (an explicit attr may shadow it)
@@ -103,9 +114,17 @@ def needed_columns(pipeline: A.Pipeline):
             walk(e.lhs)
             walk(e.rhs)
 
+    def walk_spanset(node):
+        if isinstance(node, A.SpansetFilter):
+            if node.expr is not None:
+                walk(node.expr)
+        elif isinstance(node, A.SpansetOp):
+            walk_spanset(node.lhs)
+            walk_spanset(node.rhs)
+
     for stage in pipeline.stages:
-        if isinstance(stage, A.SpansetFilter) and stage.expr is not None:
-            walk(stage.expr)
+        if isinstance(stage, (A.SpansetFilter, A.SpansetOp)):
+            walk_spanset(stage)
         elif isinstance(stage, A.AggregateFilter) and stage.field_expr is not None:
             walk(stage.field_expr)
         elif isinstance(stage, A.GroupBy):
@@ -145,13 +164,65 @@ def supports(pipeline: A.Pipeline) -> bool:
         return False
 
 
+def needs_whole_traces(pipeline: A.Pipeline) -> bool:
+    """True when evaluation reads span TOPOLOGY (parent joins): the
+    structural spanset ops, parent.* attributes, or childCount.
+
+    Per-batch joins see a complete tree only when each trace lives
+    wholly inside one block (the normal state: row groups are
+    trace-aligned and compaction merges a trace's copies). The db layer
+    checks that at runtime — if a trace id actually appears in several
+    blocks it re-runs the query on the object engine, which evaluates
+    combined traces (stronger than the reference, whose per-parquet-row
+    evaluation is always block-local, vparquet/block_traceql.go:375).
+    Bare `parent = nil` stays exempt: its zero-id form is span-local.
+    """
+
+    found = [False]
+
+    def walk_expr(e):
+        if isinstance(e, A.Attribute):
+            if e.scope == "parent":
+                found[0] = True
+        elif isinstance(e, A.Intrinsic):
+            if e.name == "childCount":
+                found[0] = True
+        elif isinstance(e, A.Unary):
+            walk_expr(e.expr)
+        elif isinstance(e, A.Binary):
+            walk_expr(e.lhs)
+            walk_expr(e.rhs)
+
+    def walk_spanset(node):
+        if isinstance(node, A.SpansetOp):
+            # `&&` needs the whole trace too: its both-operands-matched
+            # test is per TRACE, which a block holding half the trace
+            # answers differently. Only `||` is pointwise.
+            if node.op in (">", ">>", "~", "&&"):
+                found[0] = True
+            walk_spanset(node.lhs)
+            walk_spanset(node.rhs)
+        elif isinstance(node, A.SpansetFilter) and node.expr is not None:
+            walk_expr(node.expr)
+
+    for stage in pipeline.stages:
+        if isinstance(stage, (A.SpansetFilter, A.SpansetOp)):
+            walk_spanset(stage)
+        elif isinstance(stage, A.AggregateFilter) and stage.field_expr is not None:
+            walk_expr(stage.field_expr)
+        elif isinstance(stage, A.GroupBy):
+            walk_expr(stage.expr)
+        elif isinstance(stage, A.Select):
+            for e in stage.exprs:
+                walk_expr(e)
+    return found[0]
+
+
 def _validate(pipeline: A.Pipeline):
-    if not isinstance(pipeline.stages[0], A.SpansetFilter):
-        raise Unsupported("structural spanset ops")
     seen_agg = False
     seen_by = False
     for stage in pipeline.stages:
-        if isinstance(stage, A.SpansetFilter):
+        if isinstance(stage, (A.SpansetFilter, A.SpansetOp)):
             if seen_agg:
                 # the flat-mask model folds all filters together before
                 # aggregates resolve (at cross-block finalize), so a
@@ -162,8 +233,7 @@ def _validate(pipeline: A.Pipeline):
                 # same reason: a filter after by() re-filters each
                 # group, which the one-shot mask cannot express
                 raise Unsupported("filter stage after by()")
-            if stage.expr is not None:
-                _validate_expr(stage.expr)
+            _validate_spanset(stage)
         elif isinstance(stage, A.AggregateFilter):
             seen_agg = True
             if stage.field_expr is not None:
@@ -187,15 +257,32 @@ def _validate(pipeline: A.Pipeline):
             raise Unsupported(f"stage {type(stage).__name__}")
 
 
+def _validate_spanset(node):
+    """Spanset expression tree: filters composed with the structural ops
+    the mask model evaluates (&&, ||, >, >>, ~)."""
+    if isinstance(node, A.SpansetFilter):
+        if node.expr is not None:
+            _validate_expr(node.expr)
+        return
+    if isinstance(node, A.SpansetOp):
+        if node.op not in ("&&", "||", ">", ">>", "~"):
+            raise Unsupported(f"spanset op {node.op}")
+        _validate_spanset(node.lhs)
+        _validate_spanset(node.rhs)
+        return
+    # a full pipeline as operand re-runs stages per group — object engine
+    raise Unsupported(f"spanset operand {type(node).__name__}")
+
+
 def _validate_expr(e: A.Expr):
     if isinstance(e, A.Literal):
         return
     if isinstance(e, A.Attribute):
-        if e.scope == "parent":
-            raise Unsupported("parent attributes")
         return
     if isinstance(e, A.Intrinsic):
-        if e.name in ("childCount", "parent"):
+        if e.name == "parent":
+            # bare `parent` only compares against nil (root test); other
+            # uses aren't well-typed and the object engine answers them
             raise Unsupported(e.name)
         return
     if isinstance(e, A.Unary):
@@ -228,6 +315,49 @@ class _Ctx:
     # "num" kind erases int vs float, but select() must render the
     # stored type (intValue vs doubleValue) like the object engine
     _attr_vt: dict = field(default_factory=dict)
+    # structural join caches (parent row / sibling key / child counts)
+    _parent_rows: object = None
+    _child_counts: object = None
+    _sib_keys: object = None
+
+    def parent_rows(self) -> np.ndarray:
+        """Row index of each span's parent within its trace segment, -1
+        when the parent id resolves to no span (the object engine's
+        `parent_of` dict miss). One rank-compress + searchsorted join
+        over the whole batch; duplicate span ids within a trace resolve
+        to the LAST row, matching the engine's dict insert order."""
+        if self._parent_rows is None:
+            b = self.batch
+            _, seg = b.trace_boundaries()
+            sid = b.cols["span_id"]
+            par = b.cols["parent_span_id"]
+            sidp = (sid[:, 0].astype(np.uint64) << np.uint64(32)) | sid[:, 1]
+            parp = (par[:, 0].astype(np.uint64) << np.uint64(32)) | par[:, 1]
+            uniq = np.unique(np.concatenate([sidp, parp]))
+            k = np.int64(len(uniq) + 1)
+            skey = seg.astype(np.int64) * k + np.searchsorted(uniq, sidp)
+            qkey = seg.astype(np.int64) * k + np.searchsorted(uniq, parp)
+            self._sib_keys = qkey  # sibling grouping key: (seg, parent id VALUE)
+            order = np.argsort(skey, kind="stable")
+            sk = skey[order]
+            p = np.searchsorted(sk, qkey, side="right") - 1
+            safe = np.maximum(p, 0)
+            ok = (p >= 0) & (sk[safe] == qkey)
+            self._parent_rows = np.where(ok, order[safe], -1)
+        return self._parent_rows
+
+    def sibling_keys(self) -> np.ndarray:
+        if self._sib_keys is None:
+            self.parent_rows()
+        return self._sib_keys
+
+    def child_counts(self) -> np.ndarray:
+        """Spans naming each span as parent (EvalContext.child_count)."""
+        if self._child_counts is None:
+            pr = self.parent_rows()
+            self._child_counts = np.bincount(
+                pr[pr >= 0], minlength=self.n).astype(np.int64)
+        return self._child_counts
 
     def attr_is_int(self, scope: str, name: str) -> bool:
         if scope == "any":
@@ -328,6 +458,18 @@ def _eval(e: A.Expr, ctx: _Ctx):
             if ks != kr:
                 raise Unsupported(f"attr {e.name} span/resource type mismatch")
             return (ks, np.where(ds, vs, vr), ds | dr)
+        if e.scope == "parent":
+            # parent.X = X from the parent span's span-scoped attrs
+            # (Attribute.eval: parent.attributes.get(name)); gather the
+            # whole-column values through the parent-row join
+            k, v, d = ctx.attr_values("span", e.name)
+            if k is None:
+                return (None, None, np.zeros(n, bool))
+            pr = ctx.parent_rows()
+            safe = np.maximum(pr, 0)
+            defined = (pr >= 0) & d[safe]
+            vals = np.where(defined, v[safe], np.zeros(1, v.dtype))
+            return (k, vals, defined)
         return ctx.attr_values(e.scope, e.name)
     if isinstance(e, A.Intrinsic):
         b = ctx.batch
@@ -339,6 +481,8 @@ def _eval(e: A.Expr, ctx: _Ctx):
             return ("num", b.cols["status_code"].astype(np.float64), np.ones(n, bool))
         if e.name == "kind":
             return ("num", b.cols["kind"].astype(np.float64), np.ones(n, bool))
+        if e.name == "childCount":
+            return ("num", ctx.child_counts().astype(np.float64), np.ones(n, bool))
         raise Unsupported(e.name)
     if isinstance(e, A.Unary):
         k, v, d = _eval(e.expr, ctx)
@@ -364,7 +508,14 @@ def _as_bool(kind, vals, defined, n):
 
 
 def _parent_nil_mask(e: A.Binary, ctx: _Ctx):
-    """`parent = nil` / `parent != nil` -> root-span test."""
+    """`parent = nil` / `parent != nil` -> root-span test.
+
+    Deliberately the zero-parent-id test, NOT the parent-row dict-miss:
+    a trace straddling blocks leaves its non-root spans with dangling
+    parent ids in the later block, and the id test keeps matching the
+    whole-trace answer there (the dict-miss test would call them roots).
+    This keeps bare `parent = nil` span-local and exempt from the
+    whole-trace straddle guard (needs_whole_traces)."""
     sides = (e.lhs, e.rhs)
     has_parent_intr = any(isinstance(s, A.Intrinsic) and s.name == "parent" for s in sides)
     has_nil = any(isinstance(s, A.Literal) and s.kind == "nil" for s in sides)
@@ -488,11 +639,86 @@ def filter_mask(expr: A.Expr | None, batch, dictionary) -> np.ndarray:
     if expr is None:
         return np.ones(n, bool)
     ctx = _Ctx(batch=batch, d=dictionary, n=n)
+    return _filter_mask_ctx(expr, ctx)
+
+
+def _filter_mask_ctx(expr: A.Expr | None, ctx: _Ctx) -> np.ndarray:
+    if expr is None:
+        return np.ones(ctx.n, bool)
     k, v, d = _eval(expr, ctx)
     # only a boolean True matches (object engine: isinstance(v, bool) and v)
     if k != "bool":
-        return np.zeros(n, bool)
+        return np.zeros(ctx.n, bool)
     return v & d
+
+
+def _spanset_mask(node, ctx: _Ctx, base: np.ndarray | None = None) -> np.ndarray:
+    """Mask of one spanset expression (filters + structural ops). With
+    `base` set (a later pipeline stage), operand filters see only the
+    current group's spans — pointwise AND, exactly eval_spanset_expr
+    run over the group list."""
+    if isinstance(node, A.SpansetFilter):
+        m = _filter_mask_ctx(node.expr, ctx)
+        return m if base is None else m & base
+    if isinstance(node, A.SpansetOp):
+        a = _spanset_mask(node.lhs, ctx, base)
+        b = _spanset_mask(node.rhs, ctx, base)
+        return _structural_combine(node.op, a, b, ctx)
+    raise Unsupported(f"spanset operand {type(node).__name__}")
+
+
+def _seg_any(mask: np.ndarray, seg: np.ndarray, n_traces: int) -> np.ndarray:
+    hit = np.zeros(n_traces, bool)
+    np.logical_or.at(hit, seg[mask], True)
+    return hit
+
+
+def _structural_combine(op: str, a: np.ndarray, b: np.ndarray, ctx: _Ctx) -> np.ndarray:
+    """Columnar spanset algebra, matching eval_spanset_expr per trace:
+
+    &&  union when BOTH operands matched somewhere in the trace
+    ||  union
+    >   b-spans whose parent row is an a-span (one gather)
+    >>  b-spans with ANY ancestor in a (pointer-doubling closure)
+    ~   b-spans sharing a parent-id VALUE with a DIFFERENT a-span
+        (dangling parent ids group siblings too, like the engine's
+        by_parent dict — reference OpSpansetSibling)
+    """
+    firsts, seg = ctx.batch.trace_boundaries()
+    n_traces = len(firsts)
+    if op == "||":
+        return a | b
+    if op == "&&":
+        both = _seg_any(a, seg, n_traces) & _seg_any(b, seg, n_traces)
+        return (a | b) & both[seg]
+    if op == ">":
+        pr = ctx.parent_rows()
+        safe = np.maximum(pr, 0)
+        return b & (pr >= 0) & a[safe]
+    if op == ">>":
+        # ancestor-of closure by pointer doubling. Invariant after k
+        # rounds: acc[i] = OR of a[] over ancestors at distance 1..2^k,
+        # p[i] = ancestor at distance 2^k (or -1). log2(n)+1 rounds
+        # cover any simple path; the hard cap also terminates on
+        # pathological parent-id cycles (where acc has already
+        # converged — the OR is monotone over a finite set).
+        pr = ctx.parent_rows()
+        p = pr.copy()
+        acc = (p >= 0) & a[np.maximum(p, 0)]
+        rounds = max(1, int(np.ceil(np.log2(max(ctx.n, 2)))) + 1)
+        for _ in range(rounds):
+            if not (p >= 0).any():
+                break
+            safe = np.maximum(p, 0)
+            acc = acc | ((p >= 0) & acc[safe])
+            p = np.where(p >= 0, p[safe], -1)
+        return b & acc
+    if op == "~":
+        keys = ctx.sibling_keys()
+        uniq, inv = np.unique(keys, return_inverse=True)
+        cnt_a = np.bincount(inv[a], minlength=len(uniq))
+        return b & (cnt_a[inv] - a.astype(np.int64) > 0)
+    raise Unsupported(f"spanset op {op}")
 
 
 # ---------------------------------------------------------------------------
@@ -606,12 +832,17 @@ def evaluate_batch(pipeline: A.Pipeline, batch, dictionary) -> dict:
         return {}
     ctx = _Ctx(batch=batch, d=dictionary, n=n)
 
-    mask = filter_mask(pipeline.stages[0].expr, batch, dictionary)
+    mask = _spanset_mask(pipeline.stages[0], ctx)
     agg_stages = []
     for stage in pipeline.stages[1:]:
         if isinstance(stage, A.SpansetFilter):
             if mask.any():
-                mask = mask & filter_mask(stage.expr, batch, dictionary)
+                mask = mask & _filter_mask_ctx(stage.expr, ctx)
+        elif isinstance(stage, A.SpansetOp):
+            # later-stage structural op: operand filters see only the
+            # current group's spans (run_stages feeds g, not all spans)
+            if mask.any():
+                mask = _spanset_mask(stage, ctx, base=mask)
         elif isinstance(stage, A.AggregateFilter):
             agg_stages.append(stage)
         # Coalesce: no-op in the flat-mask model
