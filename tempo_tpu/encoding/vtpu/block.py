@@ -42,13 +42,21 @@ _META_COLS = ["trace_id", "parent_span_id", "start_unix_nano", "duration_nano", 
 class VtpuBackendBlock:
     """Lazy reader over one block; caches index + dictionary."""
 
-    def __init__(self, meta: BlockMeta, backend: TypedBackend, cfg: BlockConfig | None = None):
+    def __init__(self, meta: BlockMeta, backend: TypedBackend, cfg: BlockConfig | None = None,
+                 column_cache="shared"):
+        from tempo_tpu.encoding.vtpu.colcache import shared_cache
+
         self.meta = meta
         self.backend = backend
         self.cfg = cfg or BlockConfig()
         self._index: fmt.BlockIndex | None = None
         self._dict = None
         self.bytes_read = 0
+        # decoded-column LRU shared across every block of the process
+        # (reference: vparquet/readers.go + backend cache); pass
+        # column_cache=None for one-shot streaming reads (compaction)
+        # that would only churn the query working set
+        self._colcache = shared_cache() if column_cache == "shared" else column_cache
 
     # ------------------------------------------------------------------
     def index(self) -> fmt.BlockIndex:
@@ -82,7 +90,28 @@ class VtpuBackendBlock:
         return read
 
     def read_columns(self, rg: fmt.RowGroupMeta, names: list[str]) -> dict[str, np.ndarray]:
-        return fmt.decode_columns(self._reader(), rg, names)
+        """Decoded column chunks, via the process-wide cache when armed.
+        Cache keys are (block_id, page offset) — immutable content at a
+        fixed offset, so no invalidation exists to get wrong. A warm
+        read costs zero backend bytes and zero codec work; arrays come
+        back read-only (columns are immutable by convention)."""
+        cache = self._colcache
+        if cache is None:
+            return fmt.decode_columns(self._reader(), rg, names)
+        out = {}
+        missing = []
+        for name in names:
+            arr = cache.get((self.meta.block_id, rg.pages[name].offset))
+            if arr is not None:
+                out[name] = arr
+            else:
+                missing.append(name)
+        if missing:
+            dec = fmt.decode_columns(self._reader(), rg, missing)
+            for name, arr in dec.items():
+                cache.put((self.meta.block_id, rg.pages[name].offset), arr)
+                out[name] = arr
+        return out
 
     def bloom_plan(self) -> bloom.BloomPlan:
         return bloom.BloomPlan(

@@ -1,0 +1,102 @@
+"""Process-wide decoded-column cache for the default read path.
+
+Reference analog: the reference caches parquet footers/column pages
+across queries (vparquet/readers.go over tempodb/backend/cache). Here
+the unit is a DECODED column chunk: repeated queries against a hot block
+skip the ranged read AND the codec, not just the bytes (round-4 verdict
+item 7 — the backend-cache decorator helps with bytes, not decode).
+
+Keys are (block_id, page offset): blocks are immutable and content
+lives at fixed offsets, so entries never need invalidation — deletion
+just stops producing hits and the LRU ages the dead entries out.
+Cached arrays are marked read-only; every consumer treats SpanBatch
+columns as immutable by convention, and the flag turns a future
+violation into a loud error instead of silent cross-query corruption.
+
+Sizing: TEMPO_TPU_COLCACHE_MB (default 256; 0 disables). One shared
+instance serves every block of the process — queriers, the API server
+and the mesh searcher all hit the same working set, like the
+reference's shared backend cache.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+
+
+class ColumnCache:
+    """Bytes-bounded, thread-safe LRU of numpy arrays."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._lru: OrderedDict = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key):
+        with self._lock:
+            arr = self._lru.get(key)
+            if arr is not None:
+                self._lru.move_to_end(key)
+                self.hits += 1
+                return arr
+            self.misses += 1
+            return None
+
+    def put(self, key, arr) -> None:
+        try:
+            arr.setflags(write=False)
+        except ValueError:  # non-owned buffer already read-only
+            pass
+        with self._lock:
+            prev = self._lru.get(key)
+            if prev is not None:
+                # racing loaders of the same miss: replace, don't
+                # double-count (an unconditional += ratchets _bytes up
+                # and shrinks effective capacity toward zero)
+                self._bytes -= prev.nbytes
+            self._lru[key] = arr
+            self._bytes += arr.nbytes
+            while self._bytes > self.max_bytes and self._lru:
+                _, evicted = self._lru.popitem(last=False)
+                self._bytes -= evicted.nbytes
+                self.evictions += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "bytes": self._bytes,
+                "entries": len(self._lru),
+                "max_bytes": self.max_bytes,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._lru.clear()
+            self._bytes = 0
+
+
+_shared: ColumnCache | None = None
+_shared_lock = threading.Lock()
+
+
+def shared_cache() -> ColumnCache | None:
+    """The process-wide cache, or None when disabled
+    (TEMPO_TPU_COLCACHE_MB=0)."""
+    global _shared
+    if _shared is None:
+        with _shared_lock:
+            if _shared is None:
+                mb = int(os.environ.get("TEMPO_TPU_COLCACHE_MB", "256"))
+                if mb <= 0:
+                    return None
+                _shared = ColumnCache(mb << 20)
+    return _shared

@@ -213,3 +213,78 @@ class TestMeshSearcherEngine:
                    if t.batches[0][0].get("service.name"))
         got = db.search("t", SearchRequest(tags={"service.name": svc}, limit=0))
         assert got.traces, "surviving blocks should still produce hits"
+
+
+class TestSharedColumnCache:
+    """Round-4 verdict #7: the decoded-column cache serves the DEFAULT
+    read path — a warm repeated search touches zero backend bytes."""
+
+    def test_warm_search_reads_zero_backend_bytes(self):
+        import numpy as np
+
+        from tempo_tpu.backend import MockBackend, TypedBackend
+        from tempo_tpu.encoding import from_version
+        from tempo_tpu.encoding.common import BlockConfig, SearchRequest
+        from tempo_tpu.encoding.vtpu.block import VtpuBackendBlock
+        from tempo_tpu.encoding.vtpu.colcache import ColumnCache
+        from tempo_tpu.model import synth
+
+        raw = MockBackend()
+        backend = TypedBackend(raw)
+        cfg = BlockConfig(row_group_spans=128)
+        batch = synth.make_batch(64, 4, seed=5).sorted_by_trace()
+        meta = from_version("vtpu1").create_block([batch], "t", backend, cfg)
+
+        cache = ColumnCache(64 << 20)
+        blk = VtpuBackendBlock(meta, backend, cfg, column_cache=cache)
+        req = SearchRequest(tags={"name": blk.dictionary()[int(batch.cols["name"][0])]})
+        first = blk.search(req)
+        warm_start = blk.bytes_read
+        # count raw backend reads during the warm pass
+        calls = {"n": 0}
+        orig = raw.read_range
+
+        def counting(*a, **k):
+            calls["n"] += 1
+            return orig(*a, **k)
+
+        raw.read_range = counting
+        second = blk.search(req)
+        assert blk.bytes_read == warm_start, "warm search paid backend bytes"
+        assert calls["n"] == 0, f"warm search did {calls['n']} ranged reads"
+        assert [t.trace_id_hex for t in second.traces] == [
+            t.trace_id_hex for t in first.traces]
+        assert cache.hits > 0 and cache.misses > 0
+
+    def test_cached_arrays_are_read_only(self):
+        import numpy as np
+        import pytest as _pytest
+
+        from tempo_tpu.backend import MockBackend, TypedBackend
+        from tempo_tpu.encoding import from_version
+        from tempo_tpu.encoding.common import BlockConfig
+        from tempo_tpu.encoding.vtpu.block import VtpuBackendBlock
+        from tempo_tpu.encoding.vtpu.colcache import ColumnCache
+        from tempo_tpu.model import synth
+
+        backend = TypedBackend(MockBackend())
+        cfg = BlockConfig()
+        batch = synth.make_batch(16, 2, seed=6).sorted_by_trace()
+        meta = from_version("vtpu1").create_block([batch], "t", backend, cfg)
+        blk = VtpuBackendBlock(meta, backend, cfg, column_cache=ColumnCache(1 << 20))
+        rg = blk.index().row_groups[0]
+        col = blk.read_columns(rg, ["duration_nano"])["duration_nano"]
+        with _pytest.raises((ValueError, RuntimeError)):
+            col[0] = 1  # silent cross-query corruption must be impossible
+
+    def test_eviction_keeps_bytes_bounded(self):
+        import numpy as np
+
+        from tempo_tpu.encoding.vtpu.colcache import ColumnCache
+
+        c = ColumnCache(max_bytes=1000)
+        for i in range(50):
+            c.put(("b", i), np.zeros(64, np.uint8))  # 64B each
+        st = c.stats()
+        assert st["bytes"] <= 1000
+        assert st["evictions"] > 0
