@@ -319,8 +319,16 @@ def main():
         child_server()
         return
 
-    # partial state every failure artifact (crash OR watchdog) reports
-    partial: dict = {}
+    # partial state every failure artifact (crash OR watchdog) reports.
+    # ALL keys pre-created: the watchdog thread iterates this dict in
+    # fire(); assignment to existing keys never resizes it, so the
+    # concurrent update cannot raise mid-iteration
+    partial: dict = {
+        "platform": None,
+        "accel_times_s": [],
+        "cpu_single_times_s": [],
+        "cpu_native_times_s": [],
+    }
     dog = _watchdog(float(os.environ.get("BENCH_TIMEOUT_S", "2700")), partial)
     try:
         _run(dog, partial)
@@ -383,8 +391,8 @@ def _run(dog, partial: dict):
     try:
         ready = json.loads(child.stdout.readline())
         assert ready.get("ready"), ready
-        partial["cpu_single_times_s"] = single_times
-        partial["cpu_native_times_s"] = native_times
+        partial["cpu_single_times_s"] = single_times  # existing keys:
+        partial["cpu_native_times_s"] = native_times  # no dict resize
         partial["accel_times_s"] = tpu_times
         for rep in range(REPS):
             tpu_times.append(tpu_arm.one_rep())

@@ -271,7 +271,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(202, b"")
             return 202
 
-        if method != "GET":
+        if method != "GET" and path not in ("/flush", "/shutdown"):
             self._send_error(405, "method not allowed")
             return 405
 
@@ -330,7 +330,13 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, {"stores": app.kv_service.summary()})
             return 200
 
-        # admin
+        # admin — side-effecting endpoints require POST: the reference
+        # registers them for GET too, but a GET with side effects is one
+        # crawler/prefetcher away from an accidental drain if the admin
+        # port ever leaks (round-4 advisor finding)
+        if path in ("/flush", "/shutdown") and method != "POST":
+            self._send_error(405, f"{path} requires POST")
+            return 405
         if path == "/flush":
             # cut + drain everything now (reference FlushHandler,
             # modules/ingester/flush.go:170 'no jitter if immediate')
@@ -504,8 +510,8 @@ _ENDPOINTS = [
     "GET /status/profile",
     "GET /status/usage-stats",
     "GET /status/runtime_config",
-    "GET /flush",
-    "GET /shutdown",
+    "POST /flush",
+    "POST /shutdown",
     "GET /ingester/ring",
     "GET /distributor/ring",
     "GET /compactor/ring",

@@ -90,6 +90,9 @@ class RoleUnavailable(RuntimeError):
 
 class App:
     def __init__(self, cfg: AppConfig):
+        from tempo_tpu.util.xla_cache import ensure_persistent_cache
+
+        ensure_persistent_cache()  # daemon startup: arm the compile cache
         self.cfg = cfg
         target = cfg.target or "all"
         if target not in ROLES:

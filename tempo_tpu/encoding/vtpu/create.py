@@ -185,6 +185,9 @@ def write_block(
     consumed. When given, trace IDs are only counted, never retained, so
     peak memory stays bounded by one batch.
     """
+    from tempo_tpu.util.xla_cache import ensure_persistent_cache
+
+    ensure_persistent_cache()  # sketch kernels are jitted per plan
     meta = BlockMeta(tenant_id=tenant, version=cfg.version, compaction_level=compaction_level)
     if block_id:
         meta.block_id = block_id

@@ -32,6 +32,7 @@ class InstanceDesc:
     tokens: list = field(default_factory=list)
     state: str = ACTIVE
     heartbeat: float = 0.0
+    zone: str = ""  # failure domain for zone-aware replication
 
     def healthy(self, timeout_s: float, now: float) -> bool:
         return self.state == ACTIVE and (timeout_s <= 0 or now - self.heartbeat <= timeout_s)
@@ -116,19 +117,25 @@ class _JoiningStopEvent(threading.Event):
 
 class Ring:
     def __init__(self, kv: KVStore, heartbeat_timeout_s: float = 60.0,
-                 replication_factor: int = 1):
+                 replication_factor: int = 1, zone_awareness: bool = False):
         self.kv = kv
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.replication_factor = replication_factor
+        # spread each replica set across distinct zones (reference:
+        # dskit ring zone-awareness) — one replica per zone until every
+        # zone is used, then fall back to distinct instances
+        self.zone_awareness = zone_awareness
         self._unregistered: set[str] = set()
         self._reg_params: dict[str, dict] = {}
 
     # -- membership (Lifecycler role) -----------------------------------
     def register(self, instance_id: str, addr: str = "", n_tokens: int = NUM_TOKENS,
-                 seed: int | None = None) -> None:
+                 seed: int | None = None, zone: str = "") -> None:
         self._unregistered.discard(instance_id)
         # stash params so lost-registration recovery replays them verbatim
-        self._reg_params[instance_id] = {"addr": addr, "n_tokens": n_tokens, "seed": seed}
+        self._reg_params[instance_id] = {
+            "addr": addr, "n_tokens": n_tokens, "seed": seed, "zone": zone,
+        }
         rng = random.Random(seed if seed is not None else instance_id)
         tokens = sorted(rng.randrange(0, 2**32) for _ in range(n_tokens))
 
@@ -138,6 +145,7 @@ class Ring:
                 "tokens": tokens,
                 "state": ACTIVE,
                 "heartbeat": time.time(),
+                "zone": zone,
             }
             return state
 
@@ -187,6 +195,7 @@ class Ring:
                     tokens=d.get("tokens", []),
                     state=d.get("state", ACTIVE),
                     heartbeat=d.get("heartbeat", 0.0),
+                    zone=d.get("zone", ""),
                 )
             )
         return out
@@ -199,7 +208,8 @@ class Ring:
         """One consistent view for a batch of lookups — the hot ingest
         path takes one snapshot per push instead of re-reading and
         re-sorting the ring per trace."""
-        return RingSnapshot(self.healthy_instances(), self.replication_factor)
+        return RingSnapshot(self.healthy_instances(), self.replication_factor,
+                            self.zone_awareness)
 
     def get_replicas(self, token: int) -> list[InstanceDesc]:
         """Replication set for a token: walk clockwise collecting RF
@@ -244,9 +254,12 @@ class Ring:
 class RingSnapshot:
     """Immutable sorted token ring for repeated lookups."""
 
-    def __init__(self, instances: list[InstanceDesc], replication_factor: int):
+    def __init__(self, instances: list[InstanceDesc], replication_factor: int,
+                 zone_awareness: bool = False):
         self.replication_factor = replication_factor
+        self.zone_awareness = zone_awareness
         self._instances = {i.instance_id: i for i in instances}
+        self._n_zones = len({i.zone for i in instances})
         points = []
         for inst in instances:
             for t in inst.tokens:
@@ -256,15 +269,27 @@ class RingSnapshot:
         self._tokens = [t for t, _ in points]
 
     def get_replicas(self, token: int) -> list[InstanceDesc]:
+        """Walk clockwise collecting RF distinct healthy instances
+        (reference: ring.Get with Write op). With zone awareness, an
+        instance whose zone is already represented is skipped until
+        every zone holds a replica; only then (RF > zones) does the walk
+        fall back to distinct instances regardless of zone — dskit's
+        spread-then-overflow behavior."""
         if not self._points:
             return []
-        out, seen = [], set()
+        out, seen, seen_zones = [], set(), set()
         idx = bisect.bisect_right(self._tokens, token) % len(self._points)
         for step in range(len(self._points)):
             _, iid = self._points[(idx + step) % len(self._points)]
-            if iid not in seen:
-                seen.add(iid)
-                out.append(self._instances[iid])
-                if len(out) >= self.replication_factor:
-                    break
+            if iid in seen:
+                continue
+            inst = self._instances[iid]
+            if (self.zone_awareness and inst.zone in seen_zones
+                    and len(seen_zones) < self._n_zones):
+                continue
+            seen.add(iid)
+            seen_zones.add(inst.zone)
+            out.append(inst)
+            if len(out) >= self.replication_factor:
+                break
         return out

@@ -10,11 +10,9 @@ scans (pkg/parquetquery) -> ops.scan, and adds HLL/count-min sketches for
 cardinality (north star in BASELINE.json).
 """
 
-from tempo_tpu.util.xla_cache import ensure_persistent_cache
-
-# every kernel below is jitted on static plans; persist their compiles
-# across jobs and processes (a sweep's per-level bloom plans otherwise
-# each pay a fresh XLA compile — see util/xla_cache.py)
-ensure_persistent_cache()
-
-from tempo_tpu.ops import bloom, hashing, merge, scan, sketch  # noqa: F401,E402
+# NOTE: the persistent XLA compile cache (util/xla_cache.py) is armed by
+# the entry points that actually run jitted plans (App startup,
+# VtpuCompactor, write_block) — NOT as an import side effect here, so
+# merely importing tempo_tpu.ops never mutates global JAX config for
+# library consumers (round-4 advisor finding).
+from tempo_tpu.ops import bloom, hashing, merge, scan, sketch  # noqa: F401

@@ -306,8 +306,14 @@ def static_type(e: Expr) -> str:
                     raise TypeError_(f"operator {op} not defined for {t}")
             return "bool"
         if op in ("=~", "!~"):
+            # validate BOTH sides: today's grammar only produces string
+            # literals on the RHS, but the type layer must stay
+            # self-contained if that ever loosens (the reference's
+            # validator rejects `{ 1 =~ 2 }` at this layer too)
             if lt not in ("string", "unknown"):
                 raise TypeError_(f"operator {op} requires a string, got {lt}")
+            if rt not in ("string", "unknown"):
+                raise TypeError_(f"operator {op} requires a string pattern, got {rt}")
             return "bool"
         enum_num = (_int_backed_enum(e.lhs, lt) and rt in ("number", "unknown")) or (
             _int_backed_enum(e.rhs, rt) and lt in ("number", "unknown")

@@ -38,12 +38,20 @@ def overlap_enabled() -> bool:
     return usable > 1
 
 
-def prefetch_iter(iterable, depth: int = 2):
+def prefetch_iter(iterable, depth: int = 2, join_timeout_s: float = 60.0):
     """Run `iterable` on a background thread, buffering up to `depth`
     items ahead of the consumer. Exceptions re-raise at the consumer.
     Closing the returned generator (or abandoning it) stops the producer
     thread, so a consumer that fails mid-stream never leaks a thread
-    blocked on a full queue."""
+    blocked on a full queue.
+
+    BLOCKING-CLOSE CONTRACT: close() joins the producer for up to
+    `join_timeout_s` (default 60s) so the caller's cleanup cannot race a
+    producer still inside the source. A producer wedged in an
+    uncancellable call therefore stalls close() for the full timeout —
+    acceptable on the compactor (today's only caller, documented there);
+    latency-sensitive callers must pass a small join_timeout_s and
+    accept the leaked daemon thread instead."""
     q: queue.Queue = queue.Queue(maxsize=max(1, depth))
     stop = threading.Event()
 
@@ -92,12 +100,13 @@ def prefetch_iter(iterable, depth: int = 2):
         # an untimed backend read must not convert a failed job into a
         # hung daemon — leak the (daemon) thread with a warning instead,
         # which is the pre-join behavior for exactly that pathology.
-        t.join(timeout=60.0)
+        t.join(timeout=join_timeout_s)
         if t.is_alive():  # pragma: no cover - needs a wedged source
             import logging
 
             logging.getLogger(__name__).warning(
-                "prefetch producer did not quiesce within 60s; leaking daemon thread"
+                "prefetch producer did not quiesce within %.0fs; leaking daemon thread",
+                join_timeout_s,
             )
 
 

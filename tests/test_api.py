@@ -124,19 +124,24 @@ class TestServer:
 
         app, server = served_app
         app.push_traces([make_trace(seed=11, n_spans=3)])
-        status, body, _ = _get(f"{server.url}/flush")
+        # side-effecting admin endpoints are POST-only (GET -> 405, so a
+        # crawler on a leaked admin port can never force a drain)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{server.url}/flush")
+        assert ei.value.code == 405
+        status, body = _post(f"{server.url}/flush", b"", "text/plain")
         assert status == 204
         # after the drain the backend holds at least one complete block
         assert app.db.blocklist.metas("single-tenant")
 
         # embedded server: no process manager -> explicit non-termination
-        status, body, _ = _get(f"{server.url}/shutdown")
+        status, body = _post(f"{server.url}/shutdown", b"", "text/plain")
         assert status == 200 and b"not terminating" in body
 
         fired = threading.Event()
         app.on_shutdown_request = fired.set
         try:
-            status, body, _ = _get(f"{server.url}/shutdown")
+            status, body = _post(f"{server.url}/shutdown", b"", "text/plain")
             assert status == 200 and b"acknowledged" in body
             assert fired.wait(5)
         finally:

@@ -79,6 +79,49 @@ class TestRing:
         s2 = [i.instance_id for i in ring.shuffle_shard("tenant-x", 2)]
         assert s1 == s2 and len(s1) == 2
 
+    def test_zone_aware_replication_spreads_zones(self):
+        """RF=3 across 3 zones: every replica set holds one instance per
+        zone (reference: dskit ring zone-awareness)."""
+        from tempo_tpu.modules.ring import MemoryKV, Ring
+
+        ring = Ring(MemoryKV(), replication_factor=3, zone_awareness=True)
+        for z in ("a", "b", "c"):
+            for i in range(2):  # two instances per zone
+                ring.register(f"ing-{z}{i}", zone=z, seed=hash((z, i)) & 0xFFFF)
+        snap = ring.snapshot()
+        import random as _r
+
+        rng = _r.Random(3)
+        for _ in range(200):
+            reps = snap.get_replicas(rng.randrange(0, 2**32))
+            assert len(reps) == 3
+            assert sorted(r.zone for r in reps) == ["a", "b", "c"], [
+                (r.instance_id, r.zone) for r in reps]
+
+    def test_zone_aware_overflow_when_fewer_zones_than_rf(self):
+        """RF=3 with only 2 zones still yields 3 DISTINCT instances
+        (spread-then-overflow, never fewer replicas)."""
+        from tempo_tpu.modules.ring import MemoryKV, Ring
+
+        ring = Ring(MemoryKV(), replication_factor=3, zone_awareness=True)
+        for z in ("a", "b"):
+            for i in range(3):
+                ring.register(f"ing-{z}{i}", zone=z, seed=hash((z, i)) & 0xFFFF)
+        snap = ring.snapshot()
+        reps = snap.get_replicas(12345)
+        assert len(reps) == 3
+        assert len({r.instance_id for r in reps}) == 3
+        assert {r.zone for r in reps} == {"a", "b"}
+
+    def test_zone_awareness_off_ignores_zones(self):
+        from tempo_tpu.modules.ring import MemoryKV, Ring
+
+        ring = Ring(MemoryKV(), replication_factor=2, zone_awareness=False)
+        ring.register("x1", zone="a", seed=1)
+        ring.register("x2", zone="a", seed=2)
+        reps = ring.get_replicas(999)
+        assert len(reps) == 2  # same-zone pair is fine without awareness
+
     def test_owns_partitions_work(self):
         ring = Ring(MemoryKV())
         ring.register("c-0")
