@@ -62,6 +62,19 @@ def main(argv=None) -> int:
             port=cfg.server.grpc_listen_port,
         ).start()
         log.info("OTLP/Jaeger/OpenCensus gRPC receiver on :%d", grpc_server.port)
+    udp_rx = None
+    if (cfg.server.jaeger_agent_compact_port or cfg.server.jaeger_agent_binary_port) \
+            and cfg.target in ("all", "distributor"):
+        from tempo_tpu.receivers.udp import UDPAgentServer
+
+        udp_rx = UDPAgentServer(
+            app.push_traces,
+            host=cfg.server.http_listen_address,
+            compact_port=cfg.server.jaeger_agent_compact_port or None,
+            binary_port=cfg.server.jaeger_agent_binary_port or None,
+        ).start()
+        log.info("Jaeger agent UDP receiver on compact:%d binary:%d",
+                 udp_rx.compact_port, udp_rx.binary_port)
     kafka_rx = None
     if cfg.server.kafka.brokers and cfg.target in ("all", "distributor"):
         from tempo_tpu.receivers.kafka import KafkaReceiver
@@ -71,9 +84,11 @@ def main(argv=None) -> int:
             brokers=list(cfg.server.kafka.brokers),
             topic=cfg.server.kafka.topic,
             poll_interval_s=cfg.server.kafka.poll_interval_s,
+            group_id=cfg.server.kafka.group_id or None,
         ).start()
-        log.info("Kafka receiver consuming %s from %s",
-                 cfg.server.kafka.topic, cfg.server.kafka.brokers)
+        log.info("Kafka receiver consuming %s from %s (group=%s)",
+                 cfg.server.kafka.topic, cfg.server.kafka.brokers,
+                 cfg.server.kafka.group_id or "<none>")
     app.start_loops()
     log.info("tempo-tpu up: target=%s listening on %s", cfg.target, server.url)
 
@@ -91,6 +106,8 @@ def main(argv=None) -> int:
     stop.wait()
     if kafka_rx is not None:
         kafka_rx.stop()
+    if udp_rx is not None:
+        udp_rx.stop()
     if grpc_server is not None:
         grpc_server.stop()
     server.stop()

@@ -44,6 +44,8 @@ class KafkaReceiverConfig:
     brokers: list = field(default_factory=list)
     topic: str = "otlp_spans"
     poll_interval_s: float = 0.25
+    # consumer group id; empty = single-consumer offset tracking
+    group_id: str = ""
 
 
 @dataclass
@@ -53,6 +55,11 @@ class ServerConfig:
     # OTLP/Jaeger/OpenCensus gRPC ingest (reference: receiver shim port
     # 4317, the default protocol of OTel SDKs/collectors); 0 disables
     grpc_listen_port: int = 0
+    # Jaeger agent-mode UDP ports (reference shim hosts thrift_compact
+    # 6831 + thrift_binary 6832); 0 disables both here — enable
+    # explicitly like the gRPC listener
+    jaeger_agent_compact_port: int = 0
+    jaeger_agent_binary_port: int = 0
     kafka: KafkaReceiverConfig = field(default_factory=KafkaReceiverConfig)
     log_level: str = "info"
 
