@@ -111,6 +111,9 @@ class JaegerQueryBridge:
     def find_traces(self, params: dict) -> list[dict]:
         """params: Jaeger /api/traces query params (service, operation,
         tags, start/end micros, minDuration, maxDuration, limit)."""
+        return [trace_to_jaeger(t) for t in self.find_traces_model(params)]
+
+    def _search_request(self, params: dict) -> SearchRequest:
         from tempo_tpu.api.params import parse_duration_ns
 
         req = SearchRequest()
@@ -131,13 +134,20 @@ class JaegerQueryBridge:
         if params.get("maxDuration"):
             req.max_duration_ns = parse_duration_ns(params["maxDuration"])
         req.limit = int(params.get("limit") or 20)
+        return req
 
+    def find_traces_model(self, params: dict) -> list[Trace]:
+        """Like find_traces but returning model Traces — the gRPC
+        storage-plugin server (jaeger_plugin.py) encodes these into
+        api_v2 spans instead of UI JSON."""
+        req = self._search_request(params)
         resp = self.app.search(req, org_id=self.tenant)
         out = []
         for hit in resp.traces:
-            full = self.get_trace(hit.trace_id_hex)
-            if full is not None:
-                out.append(full)
+            tid = bytes.fromhex(hit.trace_id_hex.zfill(32))
+            trace = self.app.find_trace(tid, org_id=self.tenant)
+            if trace is not None:
+                out.append(trace)
         return out
 
 
