@@ -118,6 +118,75 @@ def build_inputs(backend, cfg):
     return metas
 
 
+def _fastpath_inputs(backend, cfg):
+    """Two ingester-disjoint blocks: ring-sharded ingesters own disjoint
+    trace-ID ranges (block A low half, block B high half of the ID
+    space), so compaction inputs don't overlap — the workload shape the
+    zero-decode fast path exists for."""
+    from tempo_tpu.encoding import from_version
+    from tempo_tpu.model import synth
+
+    enc = from_version("vtpu1")
+    metas = []
+    for j, high in enumerate((False, True)):
+        b = synth.make_batch(N_TRACES, SPANS_PER_TRACE, seed=400 + j)
+        tid = b.cols["trace_id"].copy()
+        if high:
+            tid[:, 0] |= np.uint32(0x80000000)
+        else:
+            tid[:, 0] &= np.uint32(0x7FFFFFFF)
+        b.cols["trace_id"] = tid
+        metas.append(enc.create_block([b.sorted_by_trace()], "bench", backend, cfg))
+    return metas
+
+
+def _fastpath_rep(reps: int = 3) -> dict:
+    """Time the zero-decode fast path against the slow (full re-encode)
+    path on identical disjoint-range inputs; publish page-relocation
+    counters so the copy-vs-reencode ratio is visible in the artifact."""
+    from tempo_tpu.backend import LocalBackend, TypedBackend
+    from tempo_tpu.encoding.common import BlockConfig, CompactionOptions
+    from tempo_tpu.encoding.vtpu.compactor import VtpuCompactor
+
+    tmp = tempfile.TemporaryDirectory(dir=_bench_dir())
+    try:
+        backend = TypedBackend(LocalBackend(tmp.name))
+        cfg = BlockConfig()
+        metas = _fastpath_inputs(backend, cfg)
+        med: dict[str, float] = {}
+        counters: dict = {}
+        for name, zd in (("fast", True), ("slow", False)):
+            opts = CompactionOptions(block_config=cfg, zero_decode=zd)
+            # warm pass excludes jit compiles, like the main arms
+            VtpuCompactor(opts).compact(metas, f"bench-warm-{name}", backend)
+            times = []
+            comp = None
+            for r in range(reps):
+                comp = VtpuCompactor(opts)
+                t0 = time.perf_counter()
+                comp.compact(metas, f"bench-{name}-{r}", backend)
+                times.append(time.perf_counter() - t0)
+            med[name] = float(np.median(times))
+            if zd:
+                total = comp.bytes_copied_verbatim + comp.bytes_reencoded
+                counters = {
+                    "pages_copied_verbatim": comp.pages_copied_verbatim,
+                    "pages_reencoded": comp.pages_reencoded,
+                    "verbatim_byte_fraction": round(
+                        comp.bytes_copied_verbatim / max(total, 1), 3),
+                }
+            print(f"[bench] fastpath {name} reps: {[round(t, 2) for t in times]}",
+                  file=sys.stderr)
+        return {
+            "blocks_per_s": round(2 / med["fast"], 3),
+            "slow_blocks_per_s": round(2 / med["slow"], 3),
+            "speedup": round(med["slow"] / med["fast"], 3),
+            **counters,
+        }
+    finally:
+        tmp.cleanup()
+
+
 class Arm:
     """One benchmark configuration: owns its backend + inputs; runs one
     timed rep on demand; verifies recall at the end."""
@@ -136,6 +205,9 @@ class Arm:
         self.jobs = [(self.metas[i], self.metas[i + 1]) for i in range(0, len(self.metas), 2)]
         self.outs: list = []
         self._rep = 0
+        # zero-decode accounting summed over every job of every rep
+        self.pages_copied_verbatim = 0
+        self.pages_reencoded = 0
         # warm the jit caches on a throwaway pair so compile time is
         # excluded (steady-state throughput, like -benchtime loops)
         self._Compactor(self.opts).compact(self.metas[:2], "bench-warm", self.backend)
@@ -147,6 +219,8 @@ class Arm:
         for j, pair in enumerate(self.jobs):
             comp = self._Compactor(self.opts)
             self.outs.extend(comp.compact(list(pair), f"bench-{self._rep}-{j}", self.backend))
+            self.pages_copied_verbatim += getattr(comp, "pages_copied_verbatim", 0)
+            self.pages_reencoded += getattr(comp, "pages_reencoded", 0)
         return time.perf_counter() - t0
 
     def finalize(self) -> dict:
@@ -328,6 +402,7 @@ def main():
         "accel_times_s": [],
         "cpu_single_times_s": [],
         "cpu_native_times_s": [],
+        "fastpath": None,
     }
     dog = _watchdog(float(os.environ.get("BENCH_TIMEOUT_S", "2700")), partial)
     try:
@@ -412,6 +487,14 @@ def _run(dog, partial: dict):
     tpu_summary = tpu_arm.finalize()
     tpu_arm.close()
 
+    # zero-decode fast path vs slow path on ingester-disjoint inputs (the
+    # headline workload interleaves 25%-duplicated IDs, so its plan is
+    # merge-heavy; this rep shows the relocation win on the block shape
+    # distinct ingesters actually produce)
+    fastpath = _fastpath_rep()
+    partial["fastpath"] = fastpath
+    print(f"[bench] fastpath: {fastpath}", file=sys.stderr)
+
     med, spread = _stats(tpu_times)
     blocks_per_s = B_BLOCKS / med
     # paired per-rep ratios: epoch noise hits both arms of a pair, so the
@@ -450,6 +533,9 @@ def _run(dog, partial: dict):
         "reps": REPS,
         "spread_pct": round(100 * spread, 1),
         "platform": partial["platform"],
+        "pages_copied_verbatim": tpu_arm.pages_copied_verbatim,
+        "pages_reencoded": tpu_arm.pages_reencoded,
+        "fastpath": fastpath,
     }))
 
 

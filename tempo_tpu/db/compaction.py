@@ -36,6 +36,14 @@ compaction_slow_jobs = metrics.counter(
     "tempodb_compaction_slow_jobs_total",
     "Compaction jobs still running past the slow-job threshold",
 )
+compaction_pages_verbatim = metrics.counter(
+    "tempodb_compaction_pages_copied_verbatim_total",
+    "Compressed pages relocated verbatim by the zero-decode fast path",
+)
+compaction_pages_reencoded = metrics.counter(
+    "tempodb_compaction_pages_reencoded_total",
+    "Pages written through decode->re-encode during compaction",
+)
 
 DEFAULT_INPUT_BLOCKS = 2  # reference: tempodb/compactor.go:21-23
 MAX_COMPACTION_RANGE = 4
@@ -117,6 +125,8 @@ class CompactionMetrics:
     bytes_written: int = 0
     spans_dropped: int = 0
     spans_combined: int = 0
+    pages_copied_verbatim: int = 0
+    pages_reencoded: int = 0
     errors: int = 0
 
 
@@ -204,4 +214,12 @@ class CompactionDriver:
         self.metrics.bytes_written += sum(m.size_bytes for m in new_metas)
         self.metrics.spans_dropped += getattr(compactor, "spans_dropped", 0)
         self.metrics.spans_combined += getattr(compactor, "spans_combined", 0)
+        verbatim = getattr(compactor, "pages_copied_verbatim", 0)
+        reencoded = getattr(compactor, "pages_reencoded", 0)
+        self.metrics.pages_copied_verbatim += verbatim
+        self.metrics.pages_reencoded += reencoded
+        if verbatim:
+            compaction_pages_verbatim.inc(verbatim, tenant=tenant)
+        if reencoded:
+            compaction_pages_reencoded.inc(reencoded, tenant=tenant)
         return new_metas

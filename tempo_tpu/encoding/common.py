@@ -64,6 +64,13 @@ class CompactionOptions:
     #              per output row group, zero per-tile plan fetches) —
     #              the placement for ICI-attached chips. Requires mesh.
     payload_plane: str = "host"
+    # zero-decode fast path (host merge only): row groups whose trace-ID
+    # range overlaps no other input block relocate their compressed
+    # pages verbatim (byte copy + page-index offset rewrite) instead of
+    # decode->gather->re-encode; dictionary-coded columns re-encode only
+    # under a non-identity dictionary remap (lazy column gather). False
+    # forces the full re-encode path everywhere (the bench's slow arm).
+    zero_decode: bool = True
 
 
 @dataclass

@@ -91,8 +91,12 @@ class VtpuBackendBlock:
 
     def read_columns(self, rg: fmt.RowGroupMeta, names: list[str]) -> dict[str, np.ndarray]:
         """Decoded column chunks, via the process-wide cache when armed.
-        Cache keys are (block_id, page offset) — immutable content at a
-        fixed offset, so no invalidation exists to get wrong. A warm
+        Cache keys are (block_id, column name, page offset) — immutable
+        content at a fixed offset, so no invalidation exists to get
+        wrong; the column name disambiguates zero-byte pages, which
+        share an offset with their neighbor (an empty attr table writes
+        several length-0 pages at one offset — offset alone would alias
+        them across columns and serve the wrong dtype/shape). A warm
         read costs zero backend bytes and zero codec work; arrays come
         back read-only (columns are immutable by convention)."""
         cache = self._colcache
@@ -101,7 +105,7 @@ class VtpuBackendBlock:
         out = {}
         missing = []
         for name in names:
-            arr = cache.get((self.meta.block_id, rg.pages[name].offset))
+            arr = cache.get((self.meta.block_id, name, rg.pages[name].offset))
             if arr is not None:
                 out[name] = arr
             else:
@@ -109,7 +113,7 @@ class VtpuBackendBlock:
         if missing:
             dec = fmt.decode_columns(self._reader(), rg, missing)
             for name, arr in dec.items():
-                cache.put((self.meta.block_id, rg.pages[name].offset), arr)
+                cache.put((self.meta.block_id, name, rg.pages[name].offset), arr)
                 out[name] = arr
         return out
 

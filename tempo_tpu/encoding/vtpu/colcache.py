@@ -6,9 +6,12 @@ the unit is a DECODED column chunk: repeated queries against a hot block
 skip the ranged read AND the codec, not just the bytes (round-4 verdict
 item 7 — the backend-cache decorator helps with bytes, not decode).
 
-Keys are (block_id, page offset): blocks are immutable and content
-lives at fixed offsets, so entries never need invalidation — deletion
-just stops producing hits and the LRU ages the dead entries out.
+Keys are (block_id, column name, page offset): blocks are immutable and
+content lives at fixed offsets, so entries never need invalidation —
+deletion just stops producing hits and the LRU ages the dead entries
+out. The column name is part of the key because zero-byte pages (empty
+columns) share one offset with their neighbors and would otherwise
+alias across columns.
 Cached arrays are marked read-only; every consumer treats SpanBatch
 columns as immutable by convention, and the flag turns a future
 violation into a loud error instead of silent cross-query corruption.

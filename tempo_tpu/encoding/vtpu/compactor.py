@@ -43,12 +43,14 @@ import jax.numpy as jnp
 
 from tempo_tpu.backend.base import BlockMeta, TypedBackend
 from tempo_tpu.encoding.common import CompactionOptions
+from tempo_tpu.encoding.vtpu import format as fmt
 from tempo_tpu.encoding.vtpu.block import VtpuBackendBlock
-from tempo_tpu.encoding.vtpu.create import DeviceSketchAccumulator, write_block
+from tempo_tpu.encoding.vtpu.create import BlockWriter, DeviceSketchAccumulator
 from tempo_tpu.model.columnar import (
     ATTR_COLUMNS,
     CODE_COLUMNS,
     SPAN_COLUMNS,
+    VT_STR,
     Dictionary,
     SpanBatch,
 )
@@ -59,6 +61,22 @@ from tempo_tpu.util.pipeline import ReadAhead, overlap_enabled, prefetch_iter
 # span columns whose values can legitimately differ between RF copies of
 # the same span; trace_id/span_id are the identity key.
 _PAYLOAD_COLS = [c for c in SPAN_COLUMNS if c not in ("trace_id", "span_id")]
+
+
+def remap_codes(remap: np.ndarray, cols: dict, attrs: dict) -> None:
+    """Apply a dictionary remap in place: span CODE_COLUMNS, attr_key,
+    and attr_str for VT_STR rows (non-string rows keep their numeric
+    payload untouched). THE single definition of which columns carry
+    dictionary codes — the streaming decode path (_BlockStream) and the
+    zero-decode lazy gather both call this, so they cannot diverge on
+    the remap invariant."""
+    for k in CODE_COLUMNS:
+        cols[k] = remap[cols[k]]
+    attrs["attr_key"] = remap[attrs["attr_key"]]
+    is_str = attrs["attr_vtype"] == VT_STR
+    attrs["attr_str"] = np.where(
+        is_str, remap[attrs["attr_str"]], attrs["attr_str"]
+    ).astype(np.uint32)
 
 
 def _sketch_tee(gen, acc):
@@ -77,6 +95,13 @@ class VtpuCompactor:
         self.opts = opts or CompactionOptions()
         self.spans_dropped = 0
         self.spans_combined = 0
+        # zero-decode accounting (host fast path): pages moved verbatim
+        # vs pages that went through decode->re-encode
+        self.pages_copied_verbatim = 0
+        self.pages_reencoded = 0
+        self.bytes_copied_verbatim = 0
+        self.bytes_reencoded = 0
+        self.row_groups_relocated = 0
         # resident-row high-water mark (stream buffers + tile), for the
         # bounded-memory contract tests
         self.max_resident_rows = 0
@@ -104,11 +129,32 @@ class VtpuCompactor:
         # first row group (instance reuse across jobs is legal)
         self._pending, self._pending_rows, self._stream_resident = [], 0, 0
         out_dict = Dictionary()
+        # column_cache=None: compaction reads every row group exactly
+        # once — caching would only evict the query working set
+        blocks = [VtpuBackendBlock(m, backend, cfg, column_cache=None) for m in metas]
+        # remap every input dictionary onto the shared output dictionary
+        # up front, in metas order (the same order the streams would) —
+        # the fast path needs the remaps before any stream exists
+        remaps = [b.dictionary().remap_onto(out_dict) for b in blocks]
+        level = max(m.compaction_level for m in metas) + 1
+
+        # zero-decode fast path: host merge only (the mesh planes stage
+        # rows to devices regardless), and max_spans_per_trace forces the
+        # decode path (a relocated row group can't be capped)
+        if (self.opts.zero_decode and self.opts.mesh is None
+                and not self.opts.max_spans_per_trace):
+            from tempo_tpu.parallel.compaction import plan_disjoint_runs
+
+            segments = plan_disjoint_runs(
+                [[(rg.min_id, rg.max_id) for rg in b.index().row_groups] for b in blocks]
+            )
+            if any(s[0] == "relocate" for s in segments):
+                return self._compact_fast(
+                    blocks, remaps, segments, tenant, backend, out_dict, level
+                )
+
         streams = [
-            # column_cache=None: compaction reads every row group exactly
-            # once — caching would only evict the query working set
-            _BlockStream(VtpuBackendBlock(m, backend, cfg, column_cache=None), out_dict)
-            for m in metas
+            _BlockStream(b, out_dict, remap=r) for b, r in zip(blocks, remaps)
         ]
         devm = sharded = sketcher = None
         self._devm = None
@@ -123,7 +169,6 @@ class VtpuCompactor:
             # overlap the host's column encode; one small D2H at the end
             sketcher = DeviceSketchAccumulator(cfg, sum(m.total_objects for m in metas))
 
-        level = max(m.compaction_level for m in metas) + 1
         # merge (device/native) runs on a producer thread, overlapped with
         # the consumer's encode+write (native codec drops the GIL) —
         # SURVEY.md 7.4's decode->kernel->encode double buffering. On a
@@ -134,11 +179,13 @@ class VtpuCompactor:
         batches = prefetch_iter(gen, depth=2) if overlap_enabled() else gen
         sketches = (devm.finish if devm else
                     sharded.finish if sharded else sketcher.finish)
+        writer = BlockWriter(tenant, backend, cfg, compaction_level=level)
         try:
-            out = write_block(
-                batches, tenant, backend, cfg, compaction_level=level,
-                sketches=sketches,
-            )
+            for batch in batches:
+                writer.append_batch(batch)
+            out = writer.finish(sketches=sketches)
+            self.pages_reencoded += writer.pages_reencoded
+            self.bytes_reencoded += writer.bytes_reencoded
             if devm is not None:
                 self.spans_combined += devm.spans_combined
         finally:
@@ -156,6 +203,164 @@ class VtpuCompactor:
             for s in streams:
                 s.close()
         return [out] if out else []
+
+    # ------------------------------------------------------------------
+    # zero-decode fast path
+    # ------------------------------------------------------------------
+
+    def _compact_fast(self, blocks, remaps, segments, tenant, backend,
+                      out_dict, level):
+        """Drive the relocation plan: verbatim page moves for disjoint
+        row groups, the streaming k-way merge for overlapping clusters —
+        in plan order, which IS global trace-ID order, into one writer.
+
+        The device sketch plane is unchanged: every trace ID (decoded
+        IDs for relocated groups, merged batches for clusters) feeds the
+        same DeviceSketchAccumulator — async dispatches, one D2H sync at
+        finish — so block sketches are identical to the slow path's.
+        """
+        cfg = self.opts.block_config
+        writer = BlockWriter(tenant, backend, cfg, compaction_level=level,
+                             dictionary=out_dict)
+        acc = DeviceSketchAccumulator(
+            cfg, sum(b.meta.total_objects for b in blocks))
+        identity = [
+            np.array_equal(r, np.arange(len(r), dtype=np.uint32)) for r in remaps
+        ]
+        # undersized groups (< half the target) take the decode path and
+        # coalesce with their plan neighbors: relocating tails 1:1 would
+        # let tiny row groups accumulate across compaction levels, where
+        # the slow path re-chunks them to row_group_spans
+        min_reloc = cfg.row_group_spans // 2
+        small: list[SpanBatch] = []
+        small_rows = 0
+
+        def flush_small():
+            nonlocal small, small_rows
+            if small:
+                batch = _concat_shared(small, out_dict)
+                small, small_rows = [], 0
+                acc.update(batch)
+                writer.append_batch(batch)
+
+        try:
+            for seg in segments:
+                if seg[0] == "relocate":
+                    _, bi, ri = seg
+                    rg = blocks[bi].index().row_groups[ri]
+                    if rg.n_spans == 0:
+                        continue
+                    self.max_resident_rows = max(self.max_resident_rows, rg.n_spans)
+                    if rg.n_spans >= min_reloc:
+                        flush_small()  # held-back rows sort before this group
+                        fallback = self._relocate_row_group(
+                            blocks[bi], remaps[bi], identity[bi], rg, writer,
+                            acc, out_dict,
+                        )
+                        if fallback is None:
+                            continue
+                        # intra-group duplicate keys (guard tripped): the
+                        # already-fetched group dedupes through the merge
+                        # plan alone — no other block overlaps it, so
+                        # global order holds
+                        merged = self._merge_tile(fallback, [fallback.num_spans], None)
+                        acc.update(merged)
+                        writer.append_batch(merged)
+                        continue
+                    raw = fmt.read_row_group_pages(blocks[bi]._reader(), rg)
+                    batch = self._decode_rg(raw, rg, remaps[bi], out_dict)
+                    small.append(self._merge_tile(batch, [batch.num_spans], None))
+                    small_rows += batch.num_spans
+                    if small_rows >= cfg.row_group_spans:
+                        flush_small()
+                else:
+                    flush_small()  # merge-cluster rows sort after
+                    rngs = seg[1]
+                    streams = [
+                        _BlockStream(blocks[b], out_dict, remap=remaps[b],
+                                     rg_range=rngs[b])
+                        for b in sorted(rngs)
+                    ]
+                    inner = self._stream_merge(streams, out_dict, None)
+                    gen = prefetch_iter(inner, depth=2) if overlap_enabled() else inner
+                    try:
+                        for batch in gen:
+                            acc.update(batch)
+                            writer.append_batch(batch)
+                    finally:
+                        gen.close()
+                        try:
+                            inner.close()
+                        except ValueError:
+                            pass  # wedged producer already logged; see compact()
+                        for s in streams:
+                            s.close()
+            flush_small()
+            out = writer.finish(sketches=acc.finish)
+        finally:
+            self.pages_copied_verbatim += writer.pages_copied_verbatim
+            self.pages_reencoded += writer.pages_reencoded
+            self.bytes_copied_verbatim += writer.bytes_copied_verbatim
+            self.bytes_reencoded += writer.bytes_reencoded
+            self.row_groups_relocated += writer.row_groups_relocated
+        return [out] if out else []
+
+    @staticmethod
+    def _decode_rg(raw_pages: dict, rg, remap, out_dict) -> SpanBatch:
+        """Full decode of one row group from already-fetched page bytes
+        (no second backend read), remapped onto the output dictionary —
+        the fast path's escape hatch for groups that can't relocate."""
+        cols = {n: fmt.decode_page(raw_pages[n], rg.pages[n]) for n in SPAN_COLUMNS}
+        attrs = {n: fmt.decode_page(raw_pages[n], rg.pages[n]) for n in ATTR_COLUMNS}
+        remap_codes(remap, cols, attrs)
+        return SpanBatch(cols=cols, attrs=attrs, dictionary=out_dict)
+
+    def _relocate_row_group(self, block, remap, identity, rg, writer, acc,
+                            out_dict):
+        """Move one disjoint row group without decoding its payload.
+
+        One ranged read fetches the group's compressed pages; only the
+        trace/span ID pages decode — for the strict-ascending guard and
+        to feed the sketch plane + exact group metadata. Under a
+        non-identity dictionary remap, the dictionary-coded pages
+        additionally decode -> remap -> re-encode (lazy column gather);
+        every other page is copied byte-for-byte.
+
+        Returns None on success. A duplicate key in the group needs the
+        slow path's dedupe: the group is then fully decoded from the
+        bytes already in hand and returned for the caller to merge.
+        """
+        raw_pages = fmt.read_row_group_pages(block._reader(), rg)
+        tid = fmt.decode_page(raw_pages["trace_id"], rg.pages["trace_id"])
+        sid = fmt.decode_page(raw_pages["span_id"], rg.pages["span_id"])
+        if not merge.np_keys_strictly_increasing(tid, sid):
+            return self._decode_rg(raw_pages, rg, remap, out_dict)
+        new = np.ones(len(tid), bool)
+        new[1:] = (tid[1:] != tid[:-1]).any(axis=1)
+        firsts = np.flatnonzero(new)
+        acc.update_ids(tid[firsts])
+        reencode: dict[str, np.ndarray] = {}
+        if not identity:
+            # lazy column gather: decode exactly the dictionary-coded
+            # pages (+ attr_vtype, which steers attr_str but relocates
+            # verbatim itself) and push them through the shared remap
+            cols = {
+                name: fmt.decode_page(raw_pages[name], rg.pages[name])
+                for name in CODE_COLUMNS
+            }
+            attrs = {
+                name: fmt.decode_page(raw_pages[name], rg.pages[name])
+                for name in ("attr_key", "attr_vtype", "attr_str")
+            }
+            remap_codes(remap, cols, attrs)
+            reencode = {**cols, "attr_key": attrs["attr_key"],
+                        "attr_str": attrs["attr_str"]}
+        writer.append_relocated(
+            rg, raw_pages, reencode,
+            min_id=fmt.id_to_hex(tid[0]), max_id=fmt.id_to_hex(tid[-1]),
+            n_traces=len(firsts),
+        )
+        return None
 
     # ------------------------------------------------------------------
     def _stream_merge(self, streams, out_dict, sharded, devm=None):
@@ -295,13 +500,22 @@ class _BlockStream:
     """Sorted row-group stream of one input block, with its dictionary
     codes remapped onto the shared output dictionary (one remap table per
     block — a block has a single dictionary — applied as vectorized
-    gathers per row group)."""
+    gathers per row group).
 
-    def __init__(self, block: VtpuBackendBlock, out_dict: Dictionary):
+    remap: precomputed dictionary remap table (the compactor builds all
+    remaps up front); None computes it here. rg_range: half-open row
+    group index range to stream (a merge segment of the zero-decode
+    plan); None streams the whole block.
+    """
+
+    def __init__(self, block: VtpuBackendBlock, out_dict: Dictionary,
+                 remap=None, rg_range: tuple[int, int] | None = None):
         self.block = block
-        self.rgs = list(block.index().row_groups)
+        rgs = list(block.index().row_groups)
+        self.rgs = rgs[rg_range[0] : rg_range[1]] if rg_range is not None else rgs
         self.pos = 0
-        self.remap = block.dictionary().remap_onto(out_dict)
+        self.remap = (block.dictionary().remap_onto(out_dict)
+                      if remap is None else remap)
         self.out_dict = out_dict
         # fetch+decode of row group i+1 overlaps the merge of row group i
         self._ahead = ReadAhead(self._load, len(self.rgs))
@@ -313,11 +527,7 @@ class _BlockStream:
         rg = self.rgs[i]
         cols = self.block.read_columns(rg, list(SPAN_COLUMNS))
         attrs = self.block.read_columns(rg, list(ATTR_COLUMNS))
-        for k in CODE_COLUMNS:
-            cols[k] = self.remap[cols[k]]
-        attrs["attr_key"] = self.remap[attrs["attr_key"]]
-        is_str = attrs["attr_vtype"] == 0  # VT_STR
-        attrs["attr_str"] = np.where(is_str, self.remap[attrs["attr_str"]], attrs["attr_str"]).astype(np.uint32)
+        remap_codes(self.remap, cols, attrs)
         return SpanBatch(cols=cols, attrs=attrs, dictionary=self.out_dict)
 
     def next_batch(self) -> SpanBatch:

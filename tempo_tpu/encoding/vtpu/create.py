@@ -120,8 +120,17 @@ class DeviceSketchAccumulator:
         if batch.num_spans == 0:
             return
         firsts, _ = batch.trace_boundaries()
-        self._pending.append(batch.cols["trace_id"][firsts])
-        self._n_pending += len(firsts)
+        self.update_ids(batch.cols["trace_id"][firsts])
+
+    def update_ids(self, ids: np.ndarray) -> None:
+        """Feed unique trace-ID limbs directly — the zero-decode
+        relocation path has the decoded ID column but never builds a
+        SpanBatch (bloom OR / HLL max are idempotent, so IDs repeated
+        across updates cannot skew the sketches)."""
+        if len(ids) == 0:
+            return
+        self._pending.append(ids)
+        self._n_pending += len(ids)
         if self._n_pending >= self._FLUSH_IDS:
             self._flush()
 
@@ -166,6 +175,191 @@ def _sketch_step(plan: "bloom.BloomPlan", hp: "sketch.HLLPlan"):
     return step
 
 
+class BlockWriter:
+    """Incremental block writer: append encoded row groups (from
+    SpanBatches) AND relocated row groups (raw compressed pages moved
+    verbatim from an input block), then finish() writes bloom/index/
+    dict/meta in the crash-safe order.
+
+    This is write_block() split open so the compactor's zero-decode fast
+    path can interleave the two append kinds in global trace-ID order;
+    write_block() below remains the one-shot wrapper every other caller
+    uses. Counters (pages_copied_verbatim / pages_reencoded and their
+    byte twins) make the copy-vs-encode split observable in bench
+    artifacts and compaction metrics.
+    """
+
+    def __init__(self, tenant: str, backend: TypedBackend, cfg: BlockConfig,
+                 block_id: str | None = None, compaction_level: int = 0,
+                 dictionary=None, collect_ids: bool = False):
+        from tempo_tpu.util.xla_cache import ensure_persistent_cache
+
+        ensure_persistent_cache()  # sketch kernels are jitted per plan
+        self.backend = backend
+        self.cfg = cfg
+        self.meta = BlockMeta(tenant_id=tenant, version=cfg.version,
+                              compaction_level=compaction_level)
+        if block_id:
+            self.meta.block_id = block_id
+        self.index = fmt.BlockIndex()
+        self.offset = 0
+        self.dictionary = dictionary
+        self.collect_ids = collect_ids
+        self._unique_ids: list[np.ndarray] = []
+        self._n_traces = 0
+        self._n_spans = 0
+        self._start_s: int | None = None
+        self._end_s = 0
+        self._min_id: str | None = None
+        self._max_id: str | None = None
+        # copy-vs-encode accounting
+        self.pages_copied_verbatim = 0
+        self.pages_reencoded = 0
+        self.bytes_copied_verbatim = 0
+        self.bytes_reencoded = 0
+        self.row_groups_relocated = 0
+
+    # ------------------------------------------------------------------
+    def _add_rg(self, rg: fmt.RowGroupMeta) -> None:
+        self.index.row_groups.append(rg)
+        self._n_spans += rg.n_spans
+        self._start_s = rg.start_s if self._start_s is None else min(self._start_s, rg.start_s)
+        self._end_s = max(self._end_s, rg.end_s)
+        self._min_id = rg.min_id if self._min_id is None else min(self._min_id, rg.min_id)
+        self._max_id = rg.max_id if self._max_id is None else max(self._max_id, rg.max_id)
+
+    def append_batch(self, batch: SpanBatch) -> None:
+        """Encode a trace-sorted SpanBatch as one or more row groups."""
+        if batch.num_spans == 0:
+            return
+        if self.dictionary is None:
+            self.dictionary = batch.dictionary
+        elif batch.dictionary is not self.dictionary:
+            raise ValueError("all batches of one block must share a dictionary")
+        firsts, _ = batch.trace_boundaries()
+        self._n_traces += len(firsts)
+        if self.collect_ids:
+            self._unique_ids.append(batch.cols["trace_id"][firsts])
+        for lo, hi in fmt.row_group_slices(batch, self.cfg.row_group_spans):
+            payload, rg = fmt.serialize_row_group(batch, lo, hi, self.offset, self.cfg.codec)
+            self.backend.append_named(self.meta, DataName, payload)
+            self.offset += len(payload)
+            self.pages_reencoded += len(rg.pages)
+            self.bytes_reencoded += len(payload)
+            self._add_rg(rg)
+
+    def append_relocated(self, rg: fmt.RowGroupMeta, raw_pages: dict,
+                         reencode: dict, min_id: str, max_id: str,
+                         n_traces: int) -> None:
+        """Relocate one input row group: copy its compressed pages
+        verbatim — per-page crc/dtype/shape/codec preserved, nothing
+        recomputed but the page-index offsets — re-encoding only the
+        columns in `reencode` (dictionary-coded columns under a
+        non-identity remap: the lazy column gather).
+
+        raw_pages: column -> compressed page bytes from the source block
+        (fmt.read_row_group_pages). min_id/max_id/n_traces come from the
+        decoded trace-ID column the relocation guard already paid for,
+        so stale input index metadata cannot propagate.
+        """
+        from tempo_tpu.encoding.vtpu import codec as codec_mod
+
+        out_codec = None
+        payload = bytearray()
+        pages: dict[str, fmt.PageMeta] = {}
+        for name, pm in rg.pages.items():
+            arr = reencode.get(name)
+            if arr is not None:
+                if out_codec is None:
+                    out_codec = codec_mod.resolve_codec(self.cfg.codec)
+                page, crc = codec_mod.encode(arr, out_codec)
+                pages[name] = fmt.PageMeta(
+                    offset=self.offset + len(payload), length=len(page),
+                    dtype=arr.dtype.str, shape=tuple(arr.shape),
+                    codec=out_codec, crc=crc,
+                )
+                self.pages_reencoded += 1
+                self.bytes_reencoded += len(page)
+            else:
+                page = raw_pages[name]
+                pages[name] = fmt.PageMeta(
+                    offset=self.offset + len(payload), length=pm.length,
+                    dtype=pm.dtype, shape=pm.shape, codec=pm.codec, crc=pm.crc,
+                )
+                self.pages_copied_verbatim += 1
+                self.bytes_copied_verbatim += len(page)
+            payload.extend(page)
+        self.backend.append_named(self.meta, DataName, bytes(payload))
+        self.offset += len(payload)
+        self._n_traces += n_traces
+        self.row_groups_relocated += 1
+        self._add_rg(fmt.RowGroupMeta(
+            n_spans=rg.n_spans, n_attrs=rg.n_attrs, min_id=min_id,
+            max_id=max_id, start_s=rg.start_s, end_s=rg.end_s,
+            n_traces=n_traces, pages=pages,
+        ))
+
+    # ------------------------------------------------------------------
+    def finish(self, sketches=None) -> BlockMeta | None:
+        """Write bloom/index/dictionary/meta (meta LAST: a block without
+        meta is invisible and gets garbage-collected). sketches:
+        zero-arg callable yielding device-accumulated block sketches;
+        without it the writer builds them from the trace IDs collected
+        by append_batch (requires collect_ids=True)."""
+        if self._n_traces == 0:
+            return None
+        meta, cfg, backend = self.meta, self.cfg, self.backend
+        if sketches is not None:
+            # index + dictionary writes first: when the device is still
+            # draining async sketch updates (large jobs), every host-side
+            # byte written here is overlap for free
+            backend.write_named(meta, ColumnIndexName, self.index.to_bytes())
+            backend.write_named(meta, DictionaryName, fmt.serialize_dictionary(self.dictionary))
+            sk = sketches()
+            plan = sk["bloom_plan"]
+            words = np.asarray(sk["bloom_words"])
+            est = int(sk["est_distinct"])
+        else:
+            ids = np.concatenate(self._unique_ids)
+            # pad IDs to a shape bucket AND size the bloom plan from the
+            # bucket: both the input shape and the plan are static to XLA,
+            # so bucketing both means the kernels compile once per bucket
+            # instead of once per distinct trace count (SURVEY.md 7.4
+            # static shapes; a fresh XLA compile per block would dwarf the
+            # kernel itself). The slightly larger plan only lowers the FP
+            # rate below budget.
+            pad = cfg.bucket_for(len(ids))
+            plan = bloom.plan(pad, cfg.bloom_fp, cfg.bloom_shard_size_bytes)
+            ids_p, valid = _pad_ids(ids, pad)
+            hp = sketch.HLLPlan(cfg.hll_precision)
+            # the dispatch is async: the device builds sketches while the
+            # host writes index + dictionary; then ONE fetch of the packed
+            # array pays a single tunnel round trip
+            out = _sketch_step(plan, hp)(jnp.asarray(ids_p), jnp.asarray(valid))
+            backend.write_named(meta, ColumnIndexName, self.index.to_bytes())
+            backend.write_named(meta, DictionaryName, fmt.serialize_dictionary(self.dictionary))
+            packed = np.asarray(out)
+            words, est = _unpack_sketch(packed, plan)
+        for s in range(plan.n_shards):
+            backend.write_named(meta, bloom_name(s), bloom.shard_to_bytes(words[s]))
+
+        meta.start_time = int(self._start_s or 0)
+        meta.end_time = int(self._end_s)
+        meta.total_objects = int(self._n_traces)
+        meta.total_spans = int(self._n_spans)
+        meta.size_bytes = self.offset
+        meta.min_id = self._min_id
+        meta.max_id = self._max_id
+        meta.total_records = len(self.index.row_groups)
+        meta.bloom_shards = plan.n_shards
+        meta.bloom_bits_per_shard = plan.bits_per_shard
+        meta.bloom_k = plan.k
+        meta.hll_precision = cfg.hll_precision
+        meta.est_distinct_traces = est
+        backend.write_block_meta(meta)  # last: makes the block visible
+        return meta
+
+
 def write_block(
     batches,
     tenant: str,
@@ -185,92 +379,9 @@ def write_block(
     consumed. When given, trace IDs are only counted, never retained, so
     peak memory stays bounded by one batch.
     """
-    from tempo_tpu.util.xla_cache import ensure_persistent_cache
-
-    ensure_persistent_cache()  # sketch kernels are jitted per plan
-    meta = BlockMeta(tenant_id=tenant, version=cfg.version, compaction_level=compaction_level)
-    if block_id:
-        meta.block_id = block_id
-
-    index = fmt.BlockIndex()
-    offset = 0
-    unique_ids: list[np.ndarray] = []
-    n_traces_total = 0
-    n_spans = 0
-    start_s, end_s = None, 0
-    min_id, max_id = None, None
-    dictionary = None
-
+    w = BlockWriter(tenant, backend, cfg, block_id=block_id,
+                    compaction_level=compaction_level,
+                    collect_ids=(sketches is None))
     for batch in batches:
-        if batch.num_spans == 0:
-            continue
-        if dictionary is None:
-            dictionary = batch.dictionary
-        elif batch.dictionary is not dictionary:
-            raise ValueError("all batches of one block must share a dictionary")
-        firsts, _ = batch.trace_boundaries()
-        n_traces_total += len(firsts)
-        if sketches is None:
-            unique_ids.append(batch.cols["trace_id"][firsts])
-        for lo, hi in fmt.row_group_slices(batch, cfg.row_group_spans):
-            payload, rg = fmt.serialize_row_group(batch, lo, hi, offset, cfg.codec)
-            backend.append_named(meta, DataName, payload)
-            offset += len(payload)
-            index.row_groups.append(rg)
-            n_spans += rg.n_spans
-            start_s = rg.start_s if start_s is None else min(start_s, rg.start_s)
-            end_s = max(end_s, rg.end_s)
-            min_id = rg.min_id if min_id is None else min(min_id, rg.min_id)
-            max_id = rg.max_id if max_id is None else max(max_id, rg.max_id)
-
-    if n_traces_total == 0:
-        return None
-
-    if sketches is not None:
-        # index + dictionary writes first: when the device is still
-        # draining async sketch updates (large jobs), every host-side
-        # byte written here is overlap for free
-        backend.write_named(meta, ColumnIndexName, index.to_bytes())
-        backend.write_named(meta, DictionaryName, fmt.serialize_dictionary(dictionary))
-        sk = sketches()
-        plan = sk["bloom_plan"]
-        words = np.asarray(sk["bloom_words"])
-        est = int(sk["est_distinct"])
-    else:
-        ids = np.concatenate(unique_ids)
-        # pad IDs to a shape bucket AND size the bloom plan from the
-        # bucket: both the input shape and the plan are static to XLA, so
-        # bucketing both means the kernels compile once per bucket instead
-        # of once per distinct trace count (SURVEY.md 7.4 static shapes; a
-        # fresh XLA compile per block would dwarf the kernel itself). The
-        # slightly larger plan only lowers the FP rate below budget.
-        pad = cfg.bucket_for(len(ids))
-        plan = bloom.plan(pad, cfg.bloom_fp, cfg.bloom_shard_size_bytes)
-        ids_p, valid = _pad_ids(ids, pad)
-        hp = sketch.HLLPlan(cfg.hll_precision)
-        # the dispatch is async: the device builds sketches while the
-        # host writes index + dictionary; then ONE fetch of the packed
-        # array pays a single tunnel round trip
-        out = _sketch_step(plan, hp)(jnp.asarray(ids_p), jnp.asarray(valid))
-        backend.write_named(meta, ColumnIndexName, index.to_bytes())
-        backend.write_named(meta, DictionaryName, fmt.serialize_dictionary(dictionary))
-        packed = np.asarray(out)
-        words, est = _unpack_sketch(packed, plan)
-    for s in range(plan.n_shards):
-        backend.write_named(meta, bloom_name(s), bloom.shard_to_bytes(words[s]))
-
-    meta.start_time = int(start_s or 0)
-    meta.end_time = int(end_s)
-    meta.total_objects = int(n_traces_total)
-    meta.total_spans = int(n_spans)
-    meta.size_bytes = offset
-    meta.min_id = min_id
-    meta.max_id = max_id
-    meta.total_records = len(index.row_groups)
-    meta.bloom_shards = plan.n_shards
-    meta.bloom_bits_per_shard = plan.bits_per_shard
-    meta.bloom_k = plan.k
-    meta.hll_precision = cfg.hll_precision
-    meta.est_distinct_traces = est
-    backend.write_block_meta(meta)  # last: makes the block visible
-    return meta
+        w.append_batch(batch)
+    return w.finish(sketches=sketches)

@@ -172,6 +172,42 @@ def serialize_row_group(batch: SpanBatch, lo: int, hi: int, base_offset: int,
     return bytes(payload), meta
 
 
+def rg_byte_span(rg: RowGroupMeta) -> tuple[int, int]:
+    """[lo, hi) absolute byte span of one row group's pages in data.bin.
+
+    Pages of a row group are written contiguously (serialize_row_group
+    and the relocation writer both lay them back to back), so the span
+    is exactly the row group's own bytes — one ranged read covers every
+    page of the group.
+    """
+    if not rg.pages:
+        return 0, 0
+    lo = min(p.offset for p in rg.pages.values())
+    hi = max(p.offset + p.length for p in rg.pages.values())
+    return lo, hi
+
+
+def read_row_group_pages(reader, rg: RowGroupMeta) -> dict[str, bytes]:
+    """Raw (still-compressed) page bytes of every column of one row
+    group, fetched with a single ranged read — the zero-decode
+    relocation path's input (no codec work happens here)."""
+    lo, hi = rg_byte_span(rg)
+    # memoryview: per-page slices stay zero-copy — the relocation path's
+    # only memcpy should be the writer's payload append
+    raw = memoryview(reader(lo, hi - lo)) if hi > lo else memoryview(b"")
+    return {
+        name: raw[pm.offset - lo : pm.offset - lo + pm.length]
+        for name, pm in rg.pages.items()
+    }
+
+
+def decode_page(page: bytes, pm: PageMeta) -> np.ndarray:
+    """Decode one already-fetched page (relocation guard + lazy gather
+    decode straight from the bytes of read_row_group_pages — no second
+    backend read)."""
+    return codec_mod.decode(page, pm.dtype, pm.shape, pm.codec, pm.crc)
+
+
 def decode_columns(reader, rg: RowGroupMeta, names: list[str]) -> dict[str, np.ndarray]:
     """Fetch+decode selected column pages of one row group.
 

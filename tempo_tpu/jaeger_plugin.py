@@ -148,7 +148,7 @@ def _chunk(spans: list[bytes]) -> bytes:
 def _decode_submsg_ts(buf: bytes) -> int:
     """Timestamp/Duration -> nanos."""
     s = ns = 0
-    for field, wt, val, _chunk_ in iter_fields(buf):
+    for field, wt, val in iter_fields(buf):
         if field == 1 and wt == 0:
             s = signed64(val)
         elif field == 2 and wt == 0:
@@ -160,28 +160,28 @@ def decode_trace_query(buf: bytes) -> dict:
     """TraceQueryParameters -> the JaegerQueryBridge params dict."""
     params: dict = {}
     tags: dict = {}
-    for field, wt, val, chunk in iter_fields(buf):
+    for field, wt, val in iter_fields(buf):
         if field == 1 and wt == 2:
-            params["service"] = chunk.decode("utf-8", "replace")
+            params["service"] = val.decode("utf-8", "replace")
         elif field == 2 and wt == 2:
-            params["operation"] = chunk.decode("utf-8", "replace")
+            params["operation"] = val.decode("utf-8", "replace")
         elif field == 3 and wt == 2:
             k = v = ""
-            for f2, w2, _v2, c2 in iter_fields(chunk):
+            for f2, w2, v2 in iter_fields(val):
                 if f2 == 1 and w2 == 2:
-                    k = c2.decode("utf-8", "replace")
+                    k = v2.decode("utf-8", "replace")
                 elif f2 == 2 and w2 == 2:
-                    v = c2.decode("utf-8", "replace")
+                    v = v2.decode("utf-8", "replace")
             if k:
                 tags[k] = v
         elif field == 4 and wt == 2:
-            params["start"] = str(_decode_submsg_ts(chunk) // 1000)
+            params["start"] = str(_decode_submsg_ts(val) // 1000)
         elif field == 5 and wt == 2:
-            params["end"] = str(_decode_submsg_ts(chunk) // 1000)
+            params["end"] = str(_decode_submsg_ts(val) // 1000)
         elif field == 6 and wt == 2:
-            params["minDuration"] = f"{_decode_submsg_ts(chunk)}ns"
+            params["minDuration"] = f"{_decode_submsg_ts(val)}ns"
         elif field == 7 and wt == 2:
-            params["maxDuration"] = f"{_decode_submsg_ts(chunk)}ns"
+            params["maxDuration"] = f"{_decode_submsg_ts(val)}ns"
         elif field == 8 and wt == 0:
             params["limit"] = str(signed64(val))
     if tags:
@@ -192,9 +192,9 @@ def decode_trace_query(buf: bytes) -> dict:
 
 
 def _first_bytes_field(buf: bytes, want: int) -> bytes:
-    for field, wt, _val, chunk in iter_fields(buf):
+    for field, wt, val in iter_fields(buf):
         if field == want and wt == 2:
-            return chunk
+            return val
     return b""
 
 

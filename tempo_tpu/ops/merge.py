@@ -127,6 +127,28 @@ def min_max_ids(trace_limbs: jnp.ndarray, valid: jnp.ndarray | None = None):
 # ---------------------------------------------------------------------------
 
 
+def np_keys_strictly_increasing(trace_limbs: np.ndarray,
+                                span_limbs: np.ndarray) -> bool:
+    """True iff the (traceID, spanID) keys are strictly ascending.
+
+    The zero-decode relocation guard: a row group whose keys are strictly
+    sorted contains no duplicate span keys, so the k-way merge over it is
+    the identity and its pages can move verbatim. Strictness matters —
+    an equal adjacent pair is a duplicate the slow path would dedupe,
+    which must force the fall-back re-encode for byte parity.
+    """
+    keys = np.concatenate([trace_limbs, span_limbs], axis=1)
+    if keys.shape[0] <= 1:
+        return True
+    prev, nxt = keys[:-1], keys[1:]
+    diff = nxt != prev
+    any_diff = diff.any(axis=1)
+    # first differing limb decides the lexicographic order
+    first = diff.argmax(axis=1)
+    rows = np.arange(len(prev))
+    return bool((any_diff & (nxt[rows, first] > prev[rows, first])).all())
+
+
 def np_merge_spans(trace_limbs: np.ndarray, span_limbs: np.ndarray,
                    valid: np.ndarray | None = None):
     keys = np.concatenate([trace_limbs, span_limbs], axis=1)

@@ -333,11 +333,14 @@ class KafkaClient:
 
     def partitions(self, topic: str) -> list[int]:
         """Metadata v1 -> partition ids of `topic` (leader checks are the
-        broker's problem for the single-broker deployments this serves)."""
+        broker's problem for the single-broker deployments this serves).
+        Records the cluster's broker count in `last_broker_count` so
+        callers can verify the single-broker assumption holds."""
         body = struct.pack(">i", 1) + _str(topic)
         resp = self._roundtrip(API_METADATA, 1, body)
         pos = 0
         (n_brokers,) = struct.unpack_from(">i", resp, pos)
+        self.last_broker_count = n_brokers
         pos += 4
         for _ in range(n_brokers):
             pos += 4  # node id
@@ -644,7 +647,15 @@ class KafkaReceiver:
         # consumer group (optional): the coordinator assigns partitions
         # and offsets commit to it, so several receiver processes share
         # a topic; without it this is the single-consumer bridge with
-        # in-memory offsets
+        # in-memory offsets.
+        #
+        # SINGLE-BROKER LIMITATION: this client holds one connection and
+        # fetches every assigned partition through it. On a multi-broker
+        # cluster, partitions whose leader is another broker would fail
+        # every fetch with NOT_LEADER errors while still holding the
+        # group assignment — silently consuming nothing. Full per-leader
+        # fetch routing is out of scope, so _join_group rejects group
+        # mode outright when Metadata reports more than one broker.
         self.group_id = group_id
         self.records = 0
         self.spans = 0
@@ -755,9 +766,18 @@ class KafkaReceiver:
         committed offsets. Keeps the member identity across rebalances;
         join() clears it on UNKNOWN_MEMBER_ID before raising, so a dead
         id can never wedge the rejoin loop."""
+        all_parts = self._client.partitions(self.topic)
+        if getattr(self._client, "last_broker_count", 1) > 1:
+            # see the group_id comment in __init__: one connection can't
+            # fetch from partitions led by other brokers, and a joined
+            # member that fetches nothing is worse than a loud failure
+            raise ValueError(
+                f"consumer-group mode requires a single-broker cluster "
+                f"(metadata reports {self._client.last_broker_count} brokers); "
+                f"drop group_id or point at a single-broker deployment"
+            )
         member = self._member or GroupMember(self._client, self.group_id, self.topic)
         self._member = member
-        all_parts = self._client.partitions(self.topic)
         assigned = member.join(all_parts)
         committed = member.fetch_offsets(assigned)
         offsets: dict[int, int] = {}
@@ -780,6 +800,14 @@ class KafkaReceiver:
                     self._client.close()
                     self._client = None
                 self._stop.wait(1.0)
+            except ValueError:
+                # configuration rejection (e.g. group mode against a
+                # multi-broker cluster): retrying can never succeed, so
+                # fail fast and stop the thread instead of log-spamming
+                self.errors += 1
+                _errors_total.inc()
+                log.exception("kafka receiver misconfigured; stopping")
+                self._stop.set()
             except Exception:
                 # a non-I/O failure must never kill the ingest thread
                 self.errors += 1

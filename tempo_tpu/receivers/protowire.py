@@ -50,9 +50,9 @@ def write_varint(out: bytearray, value: int) -> None:
 
 
 def iter_fields(buf: bytes, pos: int = 0, end: int | None = None):
-    """Yield (field_number, wire_type, value, new_pos) over a message.
+    """Yield (field_number, wire_type, value) 3-tuples over a message.
 
-    value is: int for varint/fixed; bytes (memoryview) for len-delimited.
+    value is: int for varint/fixed; bytes for len-delimited.
     """
     end = len(buf) if end is None else end
     while pos < end:

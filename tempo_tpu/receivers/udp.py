@@ -47,6 +47,10 @@ class UDPAgentServer:
         self.errors = 0
         self._socks: list[socket.socket] = []
         self._threads: list[threading.Thread] = []
+        # created here, not in start(): stop() on a never-started server
+        # must close the already-bound sockets instead of raising
+        # AttributeError and leaking them
+        self._stop = threading.Event()
         # port None disables a variant; 0 binds an ephemeral port (tests)
         self.compact_port = self.binary_port = 0
         for name, port in (("compact", compact_port), ("binary", binary_port)):
@@ -63,7 +67,6 @@ class UDPAgentServer:
                 self.binary_port = bound
 
     def start(self) -> "UDPAgentServer":
-        self._stop = threading.Event()
         for s in self._socks:
             t = threading.Thread(target=self._serve, args=(s,), daemon=True,
                                  name=f"jaeger-udp-{s.getsockname()[1]}")

@@ -141,7 +141,7 @@ class TestGrpcStoragePlugin:
 
             # GetServices
             resp = self._channel_call(ch, GET_SERVICES, b"")
-            services = [c.decode() for f, w, _v, c in iter_fields(resp)
+            services = [c.decode() for f, w, c in iter_fields(resp)
                         if f == 1 and w == 2]
             want = {r["service.name"] for t in traces for r, _ in t.batches}
             assert want <= set(services)
@@ -153,11 +153,11 @@ class TestGrpcStoragePlugin:
             chunks = self._channel_call(ch, GET_TRACE, bytes(req), stream=True)
             assert chunks
             spans = [c for chunk in chunks
-                     for f, w, _v, c in iter_fields(chunk) if f == 1 and w == 2]
+                     for f, w, c in iter_fields(chunk) if f == 1 and w == 2]
             assert len(spans) == t0.span_count()
             # each span carries our trace id + a Process submessage
             for sp in spans:
-                fields = {f: c for f, w, _v, c in iter_fields(sp) if w == 2}
+                fields = {f: c for f, w, c in iter_fields(sp) if w == 2}
                 assert fields[1] == t0.trace_id
                 assert 10 in fields  # process
 
@@ -179,21 +179,21 @@ class TestGrpcStoragePlugin:
             chunks = self._channel_call(ch, FIND_TRACES, bytes(freq), stream=True)
             found_ids = set()
             for chunk in chunks:
-                for f, w, _v, c in iter_fields(chunk):
+                for f, w, c in iter_fields(chunk):
                     if f == 1 and w == 2:
-                        for f2, w2, _v2, c2 in iter_fields(c):
+                        for f2, w2, c2 in iter_fields(c):
                             if f2 == 1 and w2 == 2:
                                 found_ids.add(c2)
             assert t0.trace_id in found_ids
 
             # FindTraceIDs
             resp = self._channel_call(ch, FIND_TRACE_IDS, bytes(freq))
-            ids = [c for f, w, _v, c in iter_fields(resp) if f == 1 and w == 2]
+            ids = [c for f, w, c in iter_fields(resp) if f == 1 and w == 2]
             assert t0.trace_id in ids
 
             # GetOperations + Capabilities answer without error
             resp = self._channel_call(ch, GET_OPERATIONS, b"")
-            ops = [c.decode() for f, w, _v, c in iter_fields(resp)
+            ops = [c.decode() for f, w, c in iter_fields(resp)
                    if f == 1 and w == 2]
             assert ops
             assert self._channel_call(ch, CAPABILITIES, b"") == b""

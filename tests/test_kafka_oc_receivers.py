@@ -623,6 +623,39 @@ class TestConsumerGroup:
         finally:
             broker.close()
 
+    def test_group_mode_rejects_multi_broker_cluster(self):
+        """The single-connection client can't fetch partitions led by
+        other brokers: group mode on a multi-broker cluster must fail
+        loudly instead of joining and silently consuming nothing."""
+        broker = FakeBroker(topic="traces", partitions=1)
+
+        def _two_broker_metadata():
+            host, port = broker.addr.rsplit(":", 1)
+            out = bytearray()
+            out += struct.pack(">i", 2)  # two brokers
+            for node in (0, 1):
+                out += struct.pack(">i", node) + _str(host) + struct.pack(">i", int(port)) + _str(None)
+            out += struct.pack(">i", 0)  # controller id
+            out += struct.pack(">i", 1)  # topics
+            out += struct.pack(">h", 0) + _str(broker.topic) + b"\x00"
+            out += struct.pack(">i", len(broker.logs))
+            for p in broker.logs:
+                out += struct.pack(">hii", 0, p, 0)
+                out += struct.pack(">ii", 1, 0)
+                out += struct.pack(">ii", 1, 0)
+            return bytes(out)
+
+        broker._metadata = _two_broker_metadata
+        try:
+            rx = KafkaReceiver(lambda *a, **k: None, brokers=[broker.addr],
+                               topic="traces", group_id="g")
+            with pytest.raises(ValueError, match="single-broker"):
+                rx.poll_once()
+            assert rx._member is None  # never joined
+            rx.stop()
+        finally:
+            broker.close()
+
     def test_rebalance_rejoins(self):
         """Heartbeat answering REBALANCE_IN_PROGRESS forces a rejoin
         with a fresh generation, keeping the member identity."""

@@ -541,3 +541,15 @@ class TestJaegerAgentUDP:
         assert srv.errors == 2
         for s in srv._socks:
             s.close()
+
+    def test_stop_before_start_closes_sockets(self):
+        """Regression: stop() on a never-started server raised
+        AttributeError (self._stop only existed after start()) and
+        leaked the bound sockets."""
+        from tempo_tpu.receivers.udp import UDPAgentServer
+
+        srv = UDPAgentServer(lambda *a, **k: None, compact_port=0, binary_port=0)
+        assert srv._socks
+        srv.stop()  # must not raise
+        for s in srv._socks:
+            assert s.fileno() == -1  # closed, not leaked
