@@ -187,6 +187,131 @@ def _fastpath_rep(reps: int = 3) -> dict:
         tmp.cleanup()
 
 
+def _search_inputs(backend, cfg, n_blocks: int = 8, traces: int = 4096,
+                   spans: int = 8):
+    """Blocks with many row groups holding two selective needles: a rare
+    "needle" service in exactly ONE row group of one block (but the
+    string in EVERY block's dictionary, so dictionary resolution alone
+    cannot prune and the presence sets must), and a duration stripe —
+    one row group of another block holds 10s+ spans while everything
+    else stays under 0.1s — so a min-duration query exercises the
+    numeric min/max maps over the EXPENSIVE column (random ns durations
+    compress ~25x worse than repeated service codes; that asymmetry is
+    where range pruning pays)."""
+    from tempo_tpu.encoding import from_version
+    from tempo_tpu.model import synth
+
+    enc = from_version("vtpu1")
+    rg = cfg.row_group_spans
+    metas = []
+    for j in range(n_blocks):
+        b = synth.make_batch(traces, spans, seed=700 + j)
+        rng = np.random.default_rng(800 + j)
+        needle = b.dictionary.add("needle-svc")
+        n = b.num_spans
+        # background durations all short (0.1-10ms)
+        b.cols["duration_nano"] = rng.integers(10**5, 10**7, size=n).astype(np.uint64)
+        if j == n_blocks // 2:
+            svc = b.cols["service"].copy()
+            # one row-group-sized stripe of the sorted rows (row groups
+            # cut at trace boundaries near row_group_spans)
+            svc[5 * rg : 5 * rg + 512] = np.uint32(needle)
+            b.cols["service"] = svc
+        if j == 1:
+            dur = b.cols["duration_nano"].copy()
+            dur[10 * rg : 10 * rg + 512] = rng.integers(
+                10**10, 2 * 10**10, size=512).astype(np.uint64)
+            b.cols["duration_nano"] = dur
+        metas.append(enc.create_block([b], "bench", backend, cfg))
+    return metas
+
+
+def _search_rep(reps: int = 3) -> dict:
+    """Read-path economy rep: selective multi-block searches with zone
+    maps on vs off (TEMPO_TPU_ZONEMAPS=0), same blocks, cold column
+    cache per run. Publishes wall time, inspectedBytes (the bytes-
+    touched economy the read path is built around) and the pruning
+    counters; asserts each arm pair returns identical hit sets."""
+    from tempo_tpu.backend import LocalBackend, TypedBackend
+    from tempo_tpu.encoding import from_version
+    from tempo_tpu.encoding.common import BlockConfig, SearchRequest, SearchResponse
+    from tempo_tpu.encoding.vtpu.colcache import shared_cache
+
+    enc = from_version("vtpu1")
+    tmp = tempfile.TemporaryDirectory(dir=_bench_dir())
+    try:
+        backend = TypedBackend(LocalBackend(tmp.name))
+        cfg = BlockConfig(row_group_spans=2048)
+        metas = _search_inputs(backend, cfg)
+        queries = {
+            "tag": SearchRequest(tags={"service": "needle-svc"}, limit=0),
+            "duration": SearchRequest(min_duration_ns=10**9, limit=0),
+        }
+
+        def run_once(req) -> SearchResponse:
+            cache = shared_cache()
+            if cache is not None:
+                cache.clear()  # every run pays its own IO
+            out = SearchResponse()
+            for m in metas:
+                out.merge(enc.open_block(m, backend, cfg).search(req))
+            return out
+
+        per_query: dict[str, dict] = {}
+        totals = {"pruned": {"s": 0.0, "bytes": 0}, "unpruned": {"s": 0.0, "bytes": 0}}
+        parity_all = True
+        for qname, req in queries.items():
+            arms: dict[str, dict] = {}
+            hitsets: dict[str, set] = {}
+            for arm, env in (("pruned", "1"), ("unpruned", "0")):
+                os.environ["TEMPO_TPU_ZONEMAPS"] = env
+                try:
+                    run_once(req)  # warm the page cache, not the column cache
+                    times = []
+                    for _ in range(reps):
+                        t0 = time.perf_counter()
+                        resp = run_once(req)
+                        times.append(time.perf_counter() - t0)
+                finally:
+                    os.environ.pop("TEMPO_TPU_ZONEMAPS", None)
+                arms[arm] = {
+                    "s": float(np.median(times)),
+                    "bytes": resp.inspected_bytes,
+                    "pruned_row_groups": resp.pruned_row_groups,
+                    "coalesced_reads": resp.coalesced_reads,
+                }
+                hitsets[arm] = {t.trace_id_hex for t in resp.traces}
+                totals[arm]["s"] += arms[arm]["s"]
+                totals[arm]["bytes"] += arms[arm]["bytes"]
+            parity = hitsets["pruned"] == hitsets["unpruned"]
+            parity_all = parity_all and parity
+            if not parity:
+                print(f"[bench] WARNING: search rep {qname!r} hit sets DIFFER "
+                      f"between pruned and unpruned arms", file=sys.stderr)
+            per_query[qname] = {
+                "pruned_s": round(arms["pruned"]["s"], 4),
+                "unpruned_s": round(arms["unpruned"]["s"], 4),
+                "speedup": round(arms["unpruned"]["s"] / max(arms["pruned"]["s"], 1e-9), 3),
+                "bytes_ratio": round(
+                    arms["unpruned"]["bytes"] / max(arms["pruned"]["bytes"], 1), 3),
+                "pruned_row_groups": arms["pruned"]["pruned_row_groups"],
+                "coalesced_reads": arms["pruned"]["coalesced_reads"],
+                "hits": len(hitsets["pruned"]),
+                "parity": parity,
+            }
+        return {
+            **per_query,
+            "inspected_bytes_pruned": totals["pruned"]["bytes"],
+            "inspected_bytes_unpruned": totals["unpruned"]["bytes"],
+            "bytes_ratio": round(
+                totals["unpruned"]["bytes"] / max(totals["pruned"]["bytes"], 1), 3),
+            "speedup": round(totals["unpruned"]["s"] / max(totals["pruned"]["s"], 1e-9), 3),
+            "parity": parity_all,
+        }
+    finally:
+        tmp.cleanup()
+
+
 class Arm:
     """One benchmark configuration: owns its backend + inputs; runs one
     timed rep on demand; verifies recall at the end."""
@@ -403,6 +528,7 @@ def main():
         "cpu_single_times_s": [],
         "cpu_native_times_s": [],
         "fastpath": None,
+        "search": None,
     }
     dog = _watchdog(float(os.environ.get("BENCH_TIMEOUT_S", "2700")), partial)
     try:
@@ -495,6 +621,12 @@ def _run(dog, partial: dict):
     partial["fastpath"] = fastpath
     print(f"[bench] fastpath: {fastpath}", file=sys.stderr)
 
+    # read-path economy: zone-map-pruned + coalesced search vs the
+    # unpruned path on identical blocks (ISSUE 4 tentpole)
+    search_rep = _search_rep()
+    partial["search"] = search_rep
+    print(f"[bench] search: {search_rep}", file=sys.stderr)
+
     med, spread = _stats(tpu_times)
     blocks_per_s = B_BLOCKS / med
     # paired per-rep ratios: epoch noise hits both arms of a pair, so the
@@ -536,6 +668,7 @@ def _run(dog, partial: dict):
         "pages_copied_verbatim": tpu_arm.pages_copied_verbatim,
         "pages_reencoded": tpu_arm.pages_reencoded,
         "fastpath": fastpath,
+        "search": search_rep,
     }))
 
 

@@ -345,17 +345,21 @@ class TempoDB:
         def job(meta):
             blk = self.encoding_for(meta.version).open_block(meta, self.backend, self.cfg.block)
             out = blk.fetch_candidates(spec, start_s, end_s)
-            # bytes returned with the result: jobs run on pool threads
+            # counters returned with the result: jobs run on pool threads
             # and a shared dict bump would race
-            return out, getattr(blk, "bytes_read", 0)
+            return (out, getattr(blk, "bytes_read", 0),
+                    getattr(blk, "pruned_row_groups", 0),
+                    getattr(blk, "coalesced_reads", 0))
 
         results, errors = self.pool.run_jobs([lambda m=m: job(m) for m in metas])
         if errors:
             raise errors[0]
         by_id: dict[bytes, list] = {}
-        for traces, bytes_read in results:
+        for traces, bytes_read, pruned, coalesced in results:
             if stats is not None:
                 stats["inspectedBytes"] = stats.get("inspectedBytes", 0) + bytes_read
+                stats["prunedRowGroups"] = stats.get("prunedRowGroups", 0) + pruned
+                stats["coalescedReads"] = stats.get("coalescedReads", 0) + coalesced
             for t in traces:
                 by_id.setdefault(t.trace_id, []).append(t)
 

@@ -137,6 +137,12 @@ class SearchResponse:
     inspected_bytes: int = 0
     inspected_traces: int = 0
     inspected_blocks: int = 0
+    # read-path economy (zone maps + coalescing): row groups skipped
+    # with zero backend reads / backend round trips saved by coalesced
+    # page reads — per query, so the pruning win is auditable alongside
+    # inspectedBytes
+    pruned_row_groups: int = 0
+    coalesced_reads: int = 0
 
     def merge(self, other: "SearchResponse", limit: int = 0) -> None:
         seen = {t.trace_id_hex for t in self.traces}
@@ -150,6 +156,8 @@ class SearchResponse:
         self.inspected_bytes += other.inspected_bytes
         self.inspected_traces += other.inspected_traces
         self.inspected_blocks += other.inspected_blocks
+        self.pruned_row_groups += other.pruned_row_groups
+        self.coalesced_reads += other.coalesced_reads
 
     def to_dict(self) -> dict:
         return {
@@ -158,6 +166,8 @@ class SearchResponse:
                 "inspectedTraces": self.inspected_traces,
                 "inspectedBytes": str(self.inspected_bytes),
                 "inspectedBlocks": self.inspected_blocks,
+                "prunedRowGroups": self.pruned_row_groups,
+                "coalescedReads": self.coalesced_reads,
             },
         }
 
@@ -178,4 +188,6 @@ class SearchResponse:
         resp.inspected_traces = m.get("inspectedTraces", 0)
         resp.inspected_bytes = int(m.get("inspectedBytes", "0"))
         resp.inspected_blocks = m.get("inspectedBlocks", 0)
+        resp.pruned_row_groups = m.get("prunedRowGroups", 0)
+        resp.coalesced_reads = m.get("coalescedReads", 0)
         return resp

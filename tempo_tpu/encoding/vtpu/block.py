@@ -4,17 +4,38 @@ Reference analogs: tempodb/encoding/vparquet/block_findtracebyid.go
 (bloom shard test then ID-column probe) and block_search.go
 (makePipelineWithRowGroups — well-known columns + attr k/v scans).
 
-Read path is projection-first: only the pages a query needs are fetched
-(ranged reads into data.bin via the index), decoded to numpy, and —
-for scans — pushed to device in bucket-padded shapes so XLA compiles a
-bounded set of kernel shapes (BlockConfig.bucket_for).
+Read path economy, in pruning order (cheapest veto first):
+1. dictionary resolution — a string absent from the block dictionary
+   kills the whole block before any index/page IO;
+2. zone maps — per-row-group column stats in the index
+   (fmt.RowGroupMeta.stats: numeric min/max + dictionary-code presence
+   sets) skip row groups with ZERO backend reads, the analog of
+   vParquet pruning on parquet page statistics;
+3. selectivity-ordered lazy evaluation — the predicate accepting the
+   fewest dictionary codes reads its column first; the moment the span
+   mask dies, no further column of that row group is fetched;
+4. coalesced ranged reads — all pages needed together fetch as one
+   gap-tolerant ranged read (pages of a row group are contiguous in
+   data.bin), so a row group costs ~1-3 backend round trips, not one
+   per page — which is also what makes httpclient hedging/caching
+   effective;
+5. prefetch — the next surviving row group's first predicate column
+   loads while the current group evaluates (util/pipeline.ReadAhead,
+   auto-disabled on single-core hosts).
+
+Predicate masks evaluate HOST-SIDE (numpy over decoded columns): a
+16k-row np.isin costs ~100us while one device dispatch through the axon
+tunnel costs ~66ms (PERF.md) — per-row-group device scans lose 600:1.
+The mesh path (parallel/search.py) remains the device road: it amortizes
+dispatch by stacking many row groups per call.
 """
 
 from __future__ import annotations
 
-import numpy as np
+import os
+import threading
 
-import jax.numpy as jnp
+import numpy as np
 
 from tempo_tpu.backend.base import (
     BlockMeta,
@@ -33,10 +54,72 @@ from tempo_tpu.encoding.common import (
 from tempo_tpu.encoding.vtpu import format as fmt
 from tempo_tpu.model.columnar import ATTR_COLUMNS, SPAN_COLUMNS, VT_STR, SpanBatch
 from tempo_tpu.model.trace import Trace, batch_to_traces
-from tempo_tpu.ops import bloom, pallas_kernels
+from tempo_tpu.ops import bloom
+from tempo_tpu.util import metrics
 
 # columns needed to build TraceSearchMetadata for matching traces
 _META_COLS = ["trace_id", "parent_span_id", "start_unix_nano", "duration_nano", "name", "service"]
+
+# process-wide read-path counters (satellite of the per-response stats):
+# /metrics exposes these so pruning behavior is observable without a
+# bench run (reference: tempodb_* promauto counters)
+pruned_row_groups_total = metrics.counter(
+    "tempodb_search_pruned_row_groups_total",
+    "Row groups skipped by zone-map pruning (zero backend reads)",
+)
+coalesced_reads_total = metrics.counter(
+    "tempodb_search_coalesced_reads_total",
+    "Backend round trips saved by coalescing page reads",
+)
+
+
+def zone_maps_enabled() -> bool:
+    """Zone-map pruning kill switch (TEMPO_TPU_ZONEMAPS=0): the bench's
+    A/B arm and the operator escape hatch if a block's stats are ever
+    suspect."""
+    return os.environ.get("TEMPO_TPU_ZONEMAPS", "1").strip().lower() not in (
+        "0", "false", "no",
+    )
+
+
+def _stats_admit(rg: fmt.RowGroupMeta, col: str, values: np.ndarray) -> bool:
+    """Can any of `values` (accepted codes / numeric values) occur in
+    this row group's column, per its zone map? Absent stats admit
+    everything — unknown never prunes."""
+    s = rg.stats.get(col) if rg.stats else None
+    if s is None:
+        return True
+    if col in fmt.STATS_NUMERIC:
+        lo, hi = s
+        v = values.astype(np.int64, copy=False)
+        return bool(((v >= lo) & (v <= hi)).any())
+    return bool(np.isin(values, np.asarray(s, np.uint32)).any())
+
+
+def zone_prunes(rg: fmt.RowGroupMeta, preds, req: SearchRequest) -> bool:
+    """True when the zone maps prove no span of this row group can match
+    the resolved tag predicates. Only POSITIVE predicates consult
+    presence sets (tag search is equality-only, so every span_eq entry
+    is positive); attr-key presence is sound for attr predicates because
+    a span without the attr row never matches them."""
+    if not rg.stats:
+        return False
+    for col, codes in preds["span_eq"]:
+        if not _stats_admit(rg, col, codes):
+            return True
+    if req.min_duration_ns or req.max_duration_ns:
+        mm = rg.stats.get("duration_nano")
+        if mm is not None:
+            if req.min_duration_ns and mm[1] < req.min_duration_ns:
+                return True
+            if req.max_duration_ns and mm[0] > req.max_duration_ns:
+                return True
+    keys = rg.stats.get("attr_key")
+    if keys is not None and preds["attr"]:
+        for key_code, _val_codes in preds["attr"]:
+            if int(key_code) not in keys:
+                return True
+    return False
 
 
 class VtpuBackendBlock:
@@ -52,6 +135,13 @@ class VtpuBackendBlock:
         self._index: fmt.BlockIndex | None = None
         self._dict = None
         self.bytes_read = 0
+        # read-path economy counters (per block instance; search()
+        # snapshots them into per-response stats)
+        self.pruned_row_groups = 0
+        self.coalesced_reads = 0  # backend round trips SAVED by coalescing
+        # counter guard: the prefetcher loads row group N+1's column on a
+        # worker thread while the caller reads N's remaining columns
+        self._io_lock = threading.Lock()
         # decoded-column LRU shared across every block of the process
         # (reference: vparquet/readers.go + backend cache); pass
         # column_cache=None for one-shot streaming reads (compaction)
@@ -82,12 +172,24 @@ class VtpuBackendBlock:
 
     def _reader(self):
         def read(offset, length):
-            self.bytes_read += length
+            with self._io_lock:
+                self.bytes_read += length
             return self.backend.read_range_named(
                 self.meta.tenant_id, self.meta.block_id, DataName, offset, length
             )
 
         return read
+
+    def _fetch_columns(self, rg: fmt.RowGroupMeta, names: list[str]) -> dict[str, np.ndarray]:
+        """Fetch+decode columns with coalesced ranged reads, accounting
+        the round trips saved vs one-read-per-page."""
+        cols, n_reads, _ = fmt.read_columns_coalesced(self._reader(), rg, names)
+        saved = len(names) - n_reads
+        if saved > 0:
+            with self._io_lock:
+                self.coalesced_reads += saved
+            coalesced_reads_total.inc(saved)
+        return cols
 
     def read_columns(self, rg: fmt.RowGroupMeta, names: list[str]) -> dict[str, np.ndarray]:
         """Decoded column chunks, via the process-wide cache when armed.
@@ -98,10 +200,12 @@ class VtpuBackendBlock:
         several length-0 pages at one offset — offset alone would alias
         them across columns and serve the wrong dtype/shape). A warm
         read costs zero backend bytes and zero codec work; arrays come
-        back read-only (columns are immutable by convention)."""
+        back read-only (columns are immutable by convention). Misses
+        fetch with coalesced gap-tolerant ranged reads (one per page
+        run, not one per page)."""
         cache = self._colcache
         if cache is None:
-            return fmt.decode_columns(self._reader(), rg, names)
+            return self._fetch_columns(rg, names)
         out = {}
         missing = []
         for name in names:
@@ -111,7 +215,7 @@ class VtpuBackendBlock:
             else:
                 missing.append(name)
         if missing:
-            dec = fmt.decode_columns(self._reader(), rg, missing)
+            dec = self._fetch_columns(rg, missing)
             for name, arr in dec.items():
                 cache.put((self.meta.block_id, name, rg.pages[name].offset), arr)
                 out[name] = arr
@@ -174,7 +278,10 @@ class VtpuBackendBlock:
         the unit of the frontend's job sharding and the serverless
         contract (reference: api.SearchBlockRequest StartPage/PagesToSearch,
         cmd/tempo-serverless/handler.go:53). row_groups=0 = all remaining."""
+        from tempo_tpu.util.pipeline import ReadAhead
+
         bytes_before = self.bytes_read
+        coalesced_before = self.coalesced_reads
         resp = SearchResponse(inspected_blocks=1)
         d = self.dictionary()
 
@@ -182,78 +289,116 @@ class VtpuBackendBlock:
         # an impossible predicate must return before any index/page IO
         preds = _resolve_tag_predicates(req, d)
         if preds is not None:  # None -> a predicate can never match here
+            # most selective predicate first: fewest accepted codes ≈
+            # fewest surviving spans, so later columns are read rarely
+            preds["span_eq"].sort(key=lambda cv: len(cv[1]))
             all_rgs = self.index().row_groups
             end_rg = (start_row_group + row_groups) if row_groups else len(all_rgs)
+            zm = zone_maps_enabled()
+            live: list = []
             for rg in all_rgs[start_row_group:end_rg]:
                 if req.start_seconds and rg.end_s < req.start_seconds:
                     continue
                 if req.end_seconds and rg.start_s > req.end_seconds:
                     continue
-                resp.inspected_traces += rg.n_traces
-                remaining = (req.limit - len(resp.traces)) if req.limit else 0
-                resp.traces.extend(self._search_row_group(rg, req, preds, limit=remaining))
-                if req.limit and len(resp.traces) >= req.limit:
-                    break
+                if zm and zone_prunes(rg, preds, req):
+                    resp.pruned_row_groups += 1
+                    continue
+                live.append(rg)
+            if resp.pruned_row_groups:
+                self.pruned_row_groups += resp.pruned_row_groups
+                pruned_row_groups_total.inc(resp.pruned_row_groups)
+
+            # prefetch: load row group N+1's first predicate column while
+            # N evaluates (no-op on single-core hosts — ReadAhead gates
+            # its worker on pipeline.overlap_enabled)
+            stage1 = ([preds["span_eq"][0][0]] if preds["span_eq"]
+                      else ["duration_nano"]
+                      if (req.min_duration_ns or req.max_duration_ns) else [])
+            ra = ReadAhead(lambda i: self.read_columns(live[i], stage1),
+                           len(live)) if stage1 and live else None
+            try:
+                for i, rg in enumerate(live):
+                    resp.inspected_traces += rg.n_traces
+                    have = ra.get(i) if ra is not None else {}
+                    remaining = (req.limit - len(resp.traces)) if req.limit else 0
+                    resp.traces.extend(self._search_row_group(
+                        rg, req, preds, limit=remaining, have_cols=have))
+                    if req.limit and len(resp.traces) >= req.limit:
+                        break
+            finally:
+                if ra is not None:
+                    ra.close()
         resp.inspected_bytes = self.bytes_read - bytes_before
+        resp.coalesced_reads = self.coalesced_reads - coalesced_before
         return resp
 
-    def _search_row_group(self, rg, req, preds, limit: int) -> list[TraceSearchMetadata]:
+    def _search_row_group(self, rg, req, preds, limit: int,
+                          have_cols: dict | None = None) -> list[TraceSearchMetadata]:
         """limit: max hits to return; 0 means unbounded.
 
-        Two-phase projection: predicate pages first; metadata pages are
-        fetched only when something matched (most row groups of a
-        selective search cost one or two pages, not seven).
+        Lazy projection in three stages: the most selective predicate's
+        column alone (usually prefetched), then — only if spans survive —
+        every remaining predicate column in ONE coalesced read, then
+        metadata pages only when something matched. Most row groups of a
+        selective search cost one page, not seven.
         """
         n = rg.n_spans
         if n == 0:
             return []
-        phase1 = {col for col, _ in preds["span_eq"]}
-        if req.min_duration_ns or req.max_duration_ns:
-            phase1.add("duration_nano")
-        cols = self.read_columns(rg, sorted(phase1)) if phase1 else {}
-        pad = self.cfg.bucket_for(n)
+        cols = dict(have_cols or {})
+        span_mask = np.ones(n, bool)
+        dur_pred = bool(req.min_duration_ns or req.max_duration_ns)
 
-        valid = np.zeros(pad, bool)
-        valid[:n] = True
-        mask = jnp.asarray(valid)
-
-        if preds["span_eq"]:
-            # ONE fused pallas pass over all stacked predicate columns
-            # (pad rows get the NO_MATCH sentinel inside the kernel prep,
-            # so they can never match)
-            mask = mask & pallas_kernels.in_set_scan(
-                [cols[col][:n] for col, _ in preds["span_eq"]],
-                [np.asarray(codes) for _, codes in preds["span_eq"]],
-                pad,
-            )
-        if req.min_duration_ns or req.max_duration_ns:
-            # uint64 doesn't exist on device without x64; the kernel
-            # compares as paired uint32 limbs
-            mask = mask & pallas_kernels.u64_range_scan(
-                cols["duration_nano"][:n],
-                req.min_duration_ns or 0,
-                req.max_duration_ns or (2**64 - 1),
-                pad,
-            )
-
-        span_mask = np.array(mask[:n])  # copy: jax buffers are read-only
+        for k, (col, codes) in enumerate(preds["span_eq"]):
+            if col not in cols:
+                if k == 0:
+                    cols.update(self.read_columns(rg, [col]))
+                else:
+                    # the mask survived the most selective predicate:
+                    # fetch everything still needed in one coalesced read
+                    rest = [c for c, _ in preds["span_eq"][k:] if c not in cols]
+                    if dur_pred and "duration_nano" not in cols:
+                        rest.append("duration_nano")
+                    cols.update(self.read_columns(rg, rest))
+            span_mask &= np.isin(cols[col], codes)
+            if not span_mask.any():
+                return []
+        if dur_pred:
+            if "duration_nano" not in cols:
+                cols.update(self.read_columns(rg, ["duration_nano"]))
+            dur = cols["duration_nano"]
+            if req.min_duration_ns:
+                span_mask &= dur >= np.uint64(req.min_duration_ns)
+            if req.max_duration_ns:
+                span_mask &= dur <= np.uint64(req.max_duration_ns)
+            if not span_mask.any():
+                return []
 
         # attr predicates: evaluate over the attr table then AND per-span
-        if span_mask.any() and preds["attr"]:
+        if preds["attr"]:
             span_mask &= attr_predicate_mask(self, rg, preds)
-
-        if not span_mask.any():
-            return []
+            if not span_mask.any():
+                return []
         return self.hits_for_mask(rg, span_mask, req, limit, have_cols=cols)
 
     def hits_for_mask(self, rg, span_mask: np.ndarray, req, limit: int = 0,
                       have_cols: dict | None = None) -> list[TraceSearchMetadata]:
         """Phase 2 of search: fetch metadata pages and roll a span hit
         mask up to TraceSearchMetadata (also the mesh scan's collector —
-        the device produces the mask, this builds the hits)."""
+        the scan produces the mask, this builds the hits).
+
+        The rollup is fully vectorized (reduceat over trace segments):
+        the per-hit Python work is only dataclass construction, so
+        unlimited searches don't pay a numpy call per trace.
+        """
         n = rg.n_spans
+        if n == 0:
+            return []
         cols = dict(have_cols or {})
-        cols.update(self.read_columns(rg, sorted(set(_META_COLS) - set(cols))))
+        missing = sorted(set(_META_COLS) - set(cols))
+        if missing:
+            cols.update(self.read_columns(rg, missing))
 
         # roll up to traces (any span matched), honoring time window
         from tempo_tpu.model.columnar import hit_trace_mask, trace_segmentation
@@ -269,29 +414,36 @@ class VtpuBackendBlock:
 
         n_traces = int(seg[-1]) + 1
         trace_hit = hit_trace_mask(seg, span_mask, n_traces)
+        hit_ts = np.flatnonzero(trace_hit)
+        if limit > 0:
+            hit_ts = hit_ts[:limit]
+        if not len(hit_ts):
+            return []
 
-        out = []
+        bounds_next = np.append(firsts[1:], n)
+        t_start = np.minimum.reduceat(starts, firsts)
+        t_end = np.maximum.reduceat(ends, firsts)
+        # root span per trace: first row with parent == 0, else first row
+        is_root = (cols["parent_span_id"] == 0).all(axis=1)
+        cand = np.where(is_root, np.arange(n), n)
+        first_root = np.minimum.reduceat(cand, firsts)
+        root = np.where(first_root < bounds_next, first_root, firsts)
+
         d = self.dictionary()
-        for t in np.flatnonzero(trace_hit):
-            lo = firsts[t]
-            hi = firsts[t + 1] if t + 1 < n_traces else n
-            rows = np.arange(lo, hi)
-            # root span: parent == 0, else first
-            roots = rows[(cols["parent_span_id"][rows] == 0).all(axis=1)]
-            root = roots[0] if len(roots) else lo
-            t_start = int(starts[rows].min())
-            t_end = int(ends[rows].max())
+        svc = cols["service"][root]
+        nm = cols["name"][root]
+        out = []
+        for t in hit_ts:
+            s = int(t_start[t])
             out.append(
                 TraceSearchMetadata(
-                    trace_id_hex=fmt.id_to_hex(tid[lo]),
-                    root_service_name=d[int(cols["service"][root])],
-                    root_trace_name=d[int(cols["name"][root])],
-                    start_time_unix_nano=t_start,
-                    duration_ms=(t_end - t_start) // 10**6,
+                    trace_id_hex=fmt.id_to_hex(tid[firsts[t]]),
+                    root_service_name=d[int(svc[t])],
+                    root_trace_name=d[int(nm[t])],
+                    start_time_unix_nano=s,
+                    duration_ms=(int(t_end[t]) - s) // 10**6,
                 )
             )
-            if limit > 0 and len(out) >= limit:
-                break
         return out
 
 
@@ -334,20 +486,43 @@ class VtpuBackendBlock:
         if not resolvers:
             fetch_all = True
 
+        # cheapest veto first: equality code sets, then numeric ranges,
+        # then attr-table scans (see _lower_condition's sel estimates)
+        resolvers.sort(key=lambda r: getattr(r, "sel", 1 << 30))
+        zm = zone_maps_enabled()
         out = []
         for rg in self.index().row_groups:
             if start_s and rg.end_s < start_s:
                 continue
             if end_s and rg.start_s > end_s:
                 continue
+            if not fetch_all and zm and resolvers:
+                # zone maps: a condition whose prune hook proves this row
+                # group empty skips it with zero backend reads. AND: any
+                # provably-empty arm vetoes; OR: every arm must prove empty
+                # (and every arm must HAVE a prune hook — negated ops
+                # deliberately don't, presence says nothing about them)
+                prunes = [r.prune(rg) for r in resolvers
+                          if getattr(r, "prune", None) is not None]
+                dead = (any(prunes) if spec.all_conditions
+                        else bool(prunes) and len(prunes) == len(resolvers) and all(prunes))
+                if dead:
+                    self.pruned_row_groups += 1
+                    pruned_row_groups_total.inc()
+                    continue
             n = rg.n_spans
             if fetch_all:
                 span_mask = np.ones(n, bool)
             else:
-                masks = [r(self, rg) for r in resolvers]
-                span_mask = masks[0]
-                for m in masks[1:]:
-                    span_mask = (span_mask & m) if spec.all_conditions else (span_mask | m)
+                # lazy short-circuit: in AND mode a dead mask means later
+                # conditions' columns are never fetched
+                span_mask = None
+                for r in resolvers:
+                    m = r(self, rg)
+                    span_mask = m if span_mask is None else (
+                        (span_mask & m) if spec.all_conditions else (span_mask | m))
+                    if spec.all_conditions and not span_mask.any():
+                        break
             if not span_mask.any():
                 continue
             tid = self.read_columns(rg, ["trace_id"])["trace_id"]
@@ -449,9 +624,41 @@ class VtpuBackendBlock:
 _STR_OPS = ("=", "=~", "!=", "!~")
 
 
+def _numeric_range_prune(col_name, op, val):
+    """prune(rg) for a numeric comparison against a [min,max] zone map,
+    or None when the op can't be range-pruned (!=: a group whose range
+    contains only `val` is theoretically prunable, but min==max==val is
+    too rare to buy complexity)."""
+    if op not in (">", ">=", "<", "<=", "="):
+        return None
+    if isinstance(val, bool) or not isinstance(val, (int, float)):
+        return None
+
+    def prune(rg):
+        mm = rg.stats.get(col_name) if rg.stats else None
+        if mm is None:
+            return False
+        lo, hi = mm
+        return {
+            ">": hi <= val,
+            ">=": hi < val,
+            "<": lo >= val,
+            "<=": lo > val,
+            "=": val < lo or val > hi,
+        }[op]
+
+    return prune
+
+
 def _lower_condition(cond, d):
     """Condition -> callable(block, rg) -> span mask, or None
     (unsupported), or "impossible" (can never match this block).
+
+    Each supported resolver carries zone-map hooks: `run.prune(rg)` —
+    True when the row group's stats prove no span can match (only
+    POSITIVE ops get one; != / !~ match spans whose code is absent from
+    the presence set, so presence can never veto them) — and `run.sel`,
+    a cost/selectivity estimate fetch_candidates orders evaluation by.
 
     Negated ops (!=, !~) lower to inverted code-set scans: a superset of
     the exact result (spans lacking the column/attr may slip through;
@@ -467,6 +674,9 @@ def _lower_condition(cond, d):
                 return np.ones(rg.n_spans, bool)
             return np.isin(c, codes, invert=invert)
 
+        if not invert and codes is not None:
+            run.prune = lambda rg: not _stats_admit(rg, col_name, codes)
+            run.sel = len(codes)
         return run
 
     def str_col(col_name):
@@ -477,30 +687,30 @@ def _lower_condition(cond, d):
             return col_mask(col_name, codes)
         return col_mask(col_name, codes, invert=True)
 
+    def numeric_col(col_name, table):
+        def run(blk, rg):
+            c = blk.read_columns(rg, [col_name])[col_name]
+            return table(c)
+
+        run.prune = _numeric_range_prune(col_name, op, val)
+        run.sel = 1000
+        return run
+
     if cond.scope == "intrinsic":
         if cond.name == "name" and op in _STR_OPS:
             return str_col("name")
         if cond.name == "duration" and op in (">", ">=", "<", "<=", "=", "!="):
-            def run(blk, rg):
-                dur = blk.read_columns(rg, ["duration_nano"])["duration_nano"]
-                return {
-                    ">": dur > val,
-                    ">=": dur >= val,
-                    "<": dur < val,
-                    "<=": dur <= val,
-                    "=": dur == val,
-                    "!=": dur != val,
-                }[op]
-
-            return run
+            return numeric_col("duration_nano", lambda dur: {
+                ">": dur > val,
+                ">=": dur >= val,
+                "<": dur < val,
+                "<=": dur <= val,
+                "=": dur == val,
+                "!=": dur != val,
+            }[op])
         if cond.name in ("status", "kind") and op in ("=", "!="):
             col = "status_code" if cond.name == "status" else "kind"
-
-            def run(blk, rg):
-                c = blk.read_columns(rg, [col])[col]
-                return (c == val) if op == "=" else (c != val)
-
-            return run
+            return numeric_col(col, lambda c: (c == val) if op == "=" else (c != val))
         return None
 
     if cond.scope in ("any", "span", "resource"):
@@ -511,18 +721,14 @@ def _lower_condition(cond, d):
         if cond.name == "http.url" and op in _STR_OPS:
             return str_col("http_url")
         if cond.name == "http.status_code" and op in ("=", "!=", ">", ">=", "<", "<="):
-            def run(blk, rg):
-                c = blk.read_columns(rg, ["http_status"])["http_status"]
-                return {
-                    "=": c == val,
-                    "!=": c != val,
-                    ">": c > val,
-                    ">=": c >= val,
-                    "<": c < val,
-                    "<=": c <= val,
-                }[op]
-
-            return run
+            return numeric_col("http_status", lambda c: {
+                "=": c == val,
+                "!=": c != val,
+                ">": c > val,
+                ">=": c >= val,
+                "<": c < val,
+                "<=": c <= val,
+            }[op])
         return _lower_attr_condition(cond, d)
 
     return None
@@ -590,6 +796,15 @@ def _lower_attr_condition(cond, d):
         mask[a["attr_span"][rows]] = True
         return mask
 
+    def prune(rg):
+        # sound for EVERY attr op, negated included: a span matches only
+        # via an attr-table row with this key, so a row group whose
+        # attr_key presence set lacks the key cannot produce matches
+        keys = rg.stats.get("attr_key") if rg.stats else None
+        return keys is not None and int(kc) not in keys
+
+    run.prune = prune
+    run.sel = 2000  # attr-table scan: six columns, evaluate last
     return run
 
 

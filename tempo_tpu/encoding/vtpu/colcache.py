@@ -102,4 +102,29 @@ def shared_cache() -> ColumnCache | None:
                 if mb <= 0:
                     return None
                 _shared = ColumnCache(mb << 20)
+                _register_metrics(_shared)
     return _shared
+
+
+def _register_metrics(cache: ColumnCache) -> None:
+    """Publish cache stats on /metrics (reference: the backend cache's
+    promauto gauges): a collector refreshes the gauges from stats() at
+    every exposition, so read-path cache behavior is observable
+    process-wide, not just per bench run."""
+    from tempo_tpu.util import metrics
+
+    gauges = {
+        name: metrics.gauge(
+            f"tempo_tpu_colcache_{name}",
+            f"Shared decoded-column cache {name} (colcache.stats)",
+        )
+        for name in ("hits", "misses", "evictions", "bytes", "entries")
+    }
+
+    def collect():
+        for name, value in cache.stats().items():
+            g = gauges.get(name)
+            if g is not None:
+                g.set(value)
+
+    metrics.register_collector(collect)

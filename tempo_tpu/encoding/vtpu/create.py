@@ -261,8 +261,29 @@ class BlockWriter:
         (fmt.read_row_group_pages). min_id/max_id/n_traces come from the
         decoded trace-ID column the relocation guard already paid for,
         so stale input index metadata cannot propagate.
+
+        Zone maps: remapped columns recompute stats from the remapped
+        arrays (input code sets are in the OLD dictionary's code space —
+        copying them would make pruning unsound); verbatim columns copy
+        the input stats when present, else decode from the page bytes
+        already in hand (legacy stats-less inputs gain zone maps on
+        their first compaction; no extra backend read either way).
         """
         from tempo_tpu.encoding.vtpu import codec as codec_mod
+
+        stat_arrays: dict = {}
+        copied_stats: dict = {}
+        for name in fmt.STATS_NUMERIC + fmt.STATS_CODES:
+            if name not in rg.pages:
+                continue
+            arr = reencode.get(name)
+            if arr is not None:
+                stat_arrays[name] = arr
+            elif name in rg.stats:
+                copied_stats[name] = rg.stats[name]
+            else:
+                stat_arrays[name] = fmt.decode_page(raw_pages[name], rg.pages[name])
+        stats = {**fmt.compute_stats(stat_arrays), **copied_stats}
 
         out_codec = None
         payload = bytearray()
@@ -296,7 +317,7 @@ class BlockWriter:
         self._add_rg(fmt.RowGroupMeta(
             n_spans=rg.n_spans, n_attrs=rg.n_attrs, min_id=min_id,
             max_id=max_id, start_s=rg.start_s, end_s=rg.end_s,
-            n_traces=n_traces, pages=pages,
+            n_traces=n_traces, pages=pages, stats=stats,
         ))
 
     # ------------------------------------------------------------------

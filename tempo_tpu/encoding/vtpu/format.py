@@ -56,6 +56,38 @@ class PageMeta:
         return PageMeta(v[0], v[1], v[2], tuple(v[3]), v[4], v[5])
 
 
+# zone-map columns (reference analog: parquet ColumnIndex min/max pages
+# that vParquet's search prunes on, tempodb/encoding/vparquet ColumnIndex
+# usage). Numeric columns carry [min, max]; dictionary-coded columns
+# carry the SET of codes present (small sets only — a set near the
+# dictionary size prunes nothing and bloats the index).
+STATS_NUMERIC = ("start_unix_nano", "duration_nano", "status_code", "http_status")
+STATS_CODES = ("name", "service", "http_method", "http_url", "attr_key")
+MAX_STAT_CODES = 256
+
+
+def compute_stats(cols: dict) -> dict:
+    """Zone-map stats for whichever stats columns appear in `cols`.
+
+    {col: [min, max]} for numeric columns, {col: sorted code list} for
+    dictionary columns. A column with too many distinct codes is OMITTED
+    (absence = unknown = never prune), never truncated — a partial code
+    set would prune row groups that actually match.
+    """
+    out: dict = {}
+    for name in STATS_NUMERIC:
+        arr = cols.get(name)
+        if arr is not None and len(arr):
+            out[name] = [int(arr.min()), int(arr.max())]
+    for name in STATS_CODES:
+        arr = cols.get(name)
+        if arr is not None and len(arr):
+            codes = np.unique(arr)
+            if len(codes) <= MAX_STAT_CODES:
+                out[name] = [int(c) for c in codes]
+    return out
+
+
 @dataclass
 class RowGroupMeta:
     n_spans: int
@@ -66,9 +98,12 @@ class RowGroupMeta:
     end_s: int
     n_traces: int = 0
     pages: dict = field(default_factory=dict)  # column name -> PageMeta
+    # zone maps: column -> [min, max] | [codes...]; {} on blocks written
+    # before stats existed (readers must treat absence as "unknown")
+    stats: dict = field(default_factory=dict)
 
     def to_json(self):
-        return {
+        d = {
             "n_spans": self.n_spans,
             "n_attrs": self.n_attrs,
             "min_id": self.min_id,
@@ -78,6 +113,9 @@ class RowGroupMeta:
             "n_traces": self.n_traces,
             "pages": {k: v.to_json() for k, v in self.pages.items()},
         }
+        if self.stats:
+            d["stats"] = self.stats
+        return d
 
     @staticmethod
     def from_json(d):
@@ -90,6 +128,7 @@ class RowGroupMeta:
             end_s=d["end_s"],
             n_traces=d.get("n_traces", 0),
             pages={k: PageMeta.from_json(v) for k, v in d["pages"].items()},
+            stats=d.get("stats", {}),
         )
 
 
@@ -168,6 +207,7 @@ def serialize_row_group(batch: SpanBatch, lo: int, hi: int, base_offset: int,
         end_s=int(end_nano) // 10**9 + 1 if n else 0,
         n_traces=n_traces,
         pages=pages,
+        stats=compute_stats(dict(cols)),
     )
     return bytes(payload), meta
 
@@ -221,6 +261,59 @@ def decode_columns(reader, rg: RowGroupMeta, names: list[str]) -> dict[str, np.n
     # fetch+decode in parallel: ranged reads block in the OS/network and
     # the native codec releases the GIL
     return dict(zip(names, codec_mod.map_pages(one, list(names))))
+
+
+# gap tolerance for coalesced page reads: a second backend round trip
+# (object-store GET latency ~10ms) costs far more than over-reading this
+# many bytes inside one ranged GET
+COALESCE_MAX_GAP = 128 << 10
+
+
+def plan_page_runs(rg: RowGroupMeta, names, max_gap: int = COALESCE_MAX_GAP):
+    """Group the pages of `names` into gap-tolerant byte runs.
+
+    Pages of a row group are contiguous in data.bin, so pages of a
+    column subset are separated only by the unneeded columns between
+    them; runs whose gaps stay under max_gap merge into one ranged read.
+    Returns [(lo, hi, [name, ...]), ...] sorted by offset.
+    """
+    spans = sorted((rg.pages[n].offset, rg.pages[n].length, n) for n in names)
+    runs: list = []
+    for off, ln, name in spans:
+        if runs and off - runs[-1][1] <= max_gap:
+            runs[-1][1] = max(runs[-1][1], off + ln)
+            runs[-1][2].append(name)
+        else:
+            runs.append([off, max(off + ln, off), [name]])
+    return [(lo, hi, ns) for lo, hi, ns in runs]
+
+
+def read_columns_coalesced(reader, rg: RowGroupMeta, names: list[str],
+                           max_gap: int = COALESCE_MAX_GAP):
+    """Fetch+decode selected columns with coalesced ranged reads: one
+    gap-tolerant read per page run instead of one read per page
+    (reference analog: parquetquery's async page reads coalescing
+    column-chunk IO), then decode pages in parallel on the codec pool.
+
+    Returns (columns dict, reads issued, bytes fetched) — bytes include
+    tolerated gaps, so callers can account true IO.
+    """
+    runs = plan_page_runs(rg, names, max_gap)
+    raw: dict[str, memoryview] = {}
+    fetched = 0
+    for lo, hi, run_names in runs:
+        buf = memoryview(reader(lo, hi - lo)) if hi > lo else memoryview(b"")
+        fetched += hi - lo
+        for name in run_names:
+            pm = rg.pages[name]
+            raw[name] = buf[pm.offset - lo : pm.offset - lo + pm.length]
+
+    def one(name):
+        pm = rg.pages[name]
+        return codec_mod.decode(raw[name], pm.dtype, pm.shape, pm.codec, pm.crc)
+
+    cols = dict(zip(names, codec_mod.map_pages(one, list(names))))
+    return cols, len(runs), fetched
 
 
 def row_group_slices(batch: SpanBatch, target_spans: int) -> list[tuple[int, int]]:

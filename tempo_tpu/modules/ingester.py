@@ -151,7 +151,13 @@ class TenantInstance:
         if not cut:
             return 0
         batch = SpanBatch.concat([seg for _, lt in cut for seg in lt.segments]).sorted_by_trace()
-        self.head.append(batch)
+        # append under the lock: cut_block_if_ready swaps self.head into
+        # completing under it, and a completing block may already be mid
+        # write_wal_block/clear() — an unlocked append can land on a block
+        # that is then cleared, silently losing the cut traces (caught by
+        # tests/test_race_stress.py::test_concurrent_push_cut_flush_search)
+        with self.lock:
+            self.head.append(batch)
         return len(cut)
 
     def cut_block_if_ready(self, now: float | None = None, immediate: bool = False):

@@ -33,15 +33,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-try:  # jax >= 0.6 exposes shard_map at top level
-    from jax import shard_map as _shard_map_mod
-
-    shard_map = jax.shard_map
-except (ImportError, AttributeError):  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
-
 from tempo_tpu.ops import bloom, merge, sketch
-from tempo_tpu.parallel.mesh import RANGE_AXIS, WINDOW_AXIS
+from tempo_tpu.parallel.mesh import RANGE_AXIS, WINDOW_AXIS, shard_map_compat
 
 
 @dataclass(frozen=True)
@@ -136,12 +129,11 @@ def make_sharded_compactor(mesh, plans: CompactionPlans):
     spec_in = P(WINDOW_AXIS, RANGE_AXIS)
     spec_acc = P(WINDOW_AXIS)
     return jax.jit(
-        shard_map(
+        shard_map_compat(
             step,
             mesh=mesh,
             in_specs=(spec_in, spec_in, spec_in, spec_acc, spec_acc, spec_acc),
             out_specs=(P(WINDOW_AXIS, RANGE_AXIS), P(WINDOW_AXIS)),
-            check_vma=False,
         ),
         # the carried accumulators are dead after each call (the caller
         # rebinds to the outputs): donating lets XLA update the sketch
@@ -319,12 +311,11 @@ def make_payload_compactor(mesh, plans: CompactionPlans):
     spec_sh = P(WINDOW_AXIS, RANGE_AXIS)
     spec_w = P(WINDOW_AXIS)
     return jax.jit(
-        shard_map(
+        shard_map_compat(
             step,
             mesh=mesh,
             in_specs=(spec_sh,) * 10 + (spec_w,) * 3,
             out_specs=((spec_sh,) * 6, (spec_w,) * 3),
-            check_vma=False,
         ),
         donate_argnums=tuple(range(4, 13)),
     )

@@ -19,6 +19,26 @@ RANGE_AXIS = "range"
 WINDOW_AXIS = "window"
 
 
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """shard_map across jax versions: the replication-check kwarg was
+    renamed check_rep -> check_vma, and disabling it is required here
+    (psum outputs are intentionally per-window, not fully replicated).
+    Try newest spelling first, fall back per TypeError."""
+    try:
+        sm = jax.shard_map
+    except AttributeError:  # pragma: no cover - old jax
+        from jax.experimental.shard_map import shard_map as sm
+    for kw in ({"check_vma": False}, {"check_rep": False}):
+        try:
+            return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+        except TypeError:
+            continue
+    # no bare-call fallback: constructing WITH the replication check
+    # enabled would only fail later, deep inside the first jit trace —
+    # fail loudly here instead if jax renames the kwarg again
+    raise TypeError("no compatible shard_map signature found")
+
+
 def mesh_shape_for(n_devices: int) -> tuple[int, int]:
     """(window, range) shape: prefer 2 windows when devices allow."""
     if n_devices >= 4 and n_devices % 2 == 0:

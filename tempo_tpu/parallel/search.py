@@ -18,6 +18,8 @@ makes jobs uniform).
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 from functools import lru_cache
 
@@ -25,13 +27,17 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-try:
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
-
 from tempo_tpu.ops import bloom
-from tempo_tpu.parallel.mesh import RANGE_AXIS, WINDOW_AXIS
+from tempo_tpu.parallel.mesh import RANGE_AXIS, WINDOW_AXIS, shard_map_compat
+
+# Serializes mesh-program dispatch across threads. Collective programs
+# (psum inside shard_map) need every participating device to run the
+# SAME execution; two concurrent calls can each capture a subset of the
+# per-device threads and deadlock waiting for the rest (reproduced by
+# tests/test_race_stress.py's concurrent-search scenario on the 8-way
+# CPU mesh). Device execution is serial per device anyway, so holding
+# one lock across dispatch + result materialization costs nothing.
+_dispatch_lock = threading.Lock()
 
 
 @lru_cache(maxsize=32)
@@ -69,12 +75,11 @@ def make_sharded_tag_scan(mesh, n_cols: int, max_codes: int = 64):
         return hit[None, None], total[None, None]
 
     return jax.jit(
-        shard_map(
+        shard_map_compat(
             step,
             mesh=mesh,
             in_specs=(P(WINDOW_AXIS, RANGE_AXIS), P(), P(WINDOW_AXIS, RANGE_AXIS)),
             out_specs=(P(WINDOW_AXIS, RANGE_AXIS), P(WINDOW_AXIS)),
-            check_vma=False,
         )
     )
 
@@ -102,12 +107,11 @@ def make_sharded_bloom_test(mesh, p: bloom.BloomPlan):
         return local(words[0, 0], limbs)[None, None]
 
     return jax.jit(
-        shard_map(
+        shard_map_compat(
             step,
             mesh=mesh,
             in_specs=(P(WINDOW_AXIS, RANGE_AXIS), P()),
             out_specs=P(WINDOW_AXIS, RANGE_AXIS),
-            check_vma=False,
         )
     )
 
@@ -138,12 +142,11 @@ def make_sharded_tag_scan_per_shard(mesh, n_cols: int, max_codes: int = 64):
 
     spec = P(WINDOW_AXIS, RANGE_AXIS)
     return jax.jit(
-        shard_map(
+        shard_map_compat(
             step,
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=(spec, P(WINDOW_AXIS)),
-            check_vma=False,
         )
     )
 
@@ -173,6 +176,9 @@ class MeshSearcher:
         # per-job device/transfer accounting (round-4 verdict #5: the
         # artifact must let a reviewer audit the scaling story)
         self.last_stats: dict = {}
+        # lifetime zone-map pruning count (also on /metrics via the
+        # process-wide tempodb_search_pruned_row_groups_total counter)
+        self.pruned_row_groups = 0
 
     # -- column cache ----------------------------------------------------
     # round-4 promoted the searcher's private LRU into the process-wide
@@ -212,9 +218,15 @@ class MeshSearcher:
         import logging
 
         from tempo_tpu.encoding.common import SearchResponse
-        from tempo_tpu.encoding.vtpu.block import _resolve_tag_predicates
+        from tempo_tpu.encoding.vtpu.block import (
+            _resolve_tag_predicates,
+            pruned_row_groups_total,
+            zone_maps_enabled,
+            zone_prunes,
+        )
 
         log = logging.getLogger(__name__)
+        zm = zone_maps_enabled()
         resp = SearchResponse()
         stats = self.last_stats = {
             "dispatches": 0, "units_scanned": 0, "h2d_bytes": 0,
@@ -302,12 +314,13 @@ class MeshSearcher:
                     codes[s, c, 0] = 0
                 valid[s, : rg.n_spans] = True
                 live.append(s)
-            masks, _totals = scan(
-                jnp.asarray(cols.reshape(self.w, self.r, n_cols, pad)),
-                jnp.asarray(codes.reshape(self.w, self.r, n_cols, self.max_codes)),
-                jnp.asarray(valid.reshape(self.w, self.r, pad)),
-            )
-            masks_np = np.asarray(masks).reshape(cap, pad)
+            with _dispatch_lock:
+                masks, _totals = scan(
+                    jnp.asarray(cols.reshape(self.w, self.r, n_cols, pad)),
+                    jnp.asarray(codes.reshape(self.w, self.r, n_cols, self.max_codes)),
+                    jnp.asarray(valid.reshape(self.w, self.r, pad)),
+                )
+                masks_np = np.asarray(masks).reshape(cap, pad)
             stats["dispatches"] += 1
             stats["units_scanned"] += len(live)
             stats["collectives"] += 1  # psum of the per-window hit count
@@ -350,6 +363,12 @@ class MeshSearcher:
                     continue
                 if req.end_seconds and rg.start_s > req.end_seconds:
                     continue
+                if zm and zone_prunes(rg, preds, req):
+                    # zero reads, zero device lanes for this unit
+                    resp.pruned_row_groups += 1
+                    self.pruned_row_groups += 1
+                    pruned_row_groups_total.inc()
+                    continue
                 pending.append((blk, i, rg, preds))
                 if len(pending) >= cap:
                     flush(pending)
@@ -371,6 +390,7 @@ class MeshSearcher:
         # inspected bytes = actual IO of every opened block (cache hits
         # cost no IO and are deliberately not counted)
         resp.inspected_bytes = sum(b.bytes_read for b in opened)
+        resp.coalesced_reads = sum(getattr(b, "coalesced_reads", 0) for b in opened)
         return resp
 
 

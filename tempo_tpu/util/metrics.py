@@ -151,7 +151,29 @@ class Registry:
 
     def __init__(self):
         self._metrics: dict[str, object] = {}
+        self._collectors: list = []
         self._lock = threading.Lock()
+
+    def register_collector(self, fn) -> None:
+        """fn() runs before every exposition to refresh gauges whose
+        truth lives elsewhere (the shared column cache, process state) —
+        promauto's GaugeFunc analog. Collectors must be idempotent and
+        cheap; a raising collector is dropped from the exposition, not
+        fatal (a broken gauge must not take /metrics down)."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    def _run_collectors(self) -> None:
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 - see register_collector
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "metrics collector failed", exc_info=True)
 
     def _get_or_make(self, cls, name, help_, **kw):
         with self._lock:
@@ -173,6 +195,7 @@ class Registry:
         return self._get_or_make(Histogram, name, help_, buckets=buckets)
 
     def expose(self) -> str:
+        self._run_collectors()
         with self._lock:
             metrics = sorted(self._metrics.items())
         lines: list[str] = []
@@ -203,3 +226,4 @@ gauge = REGISTRY.gauge
 histogram = REGISTRY.histogram
 expose = REGISTRY.expose
 snapshot_totals = REGISTRY.snapshot_totals
+register_collector = REGISTRY.register_collector

@@ -1,0 +1,295 @@
+"""Zone-map pruning soundness.
+
+The contract under test: pruning is an OPTIMIZATION, never a filter —
+for every query shape (equality, regex, negation, numeric ranges, attr
+predicates, AND/OR fetch specs) the pruned read path must return
+byte-identical hits to the unpruned one, while touching fewer bytes on
+selective queries. Plus the format contracts: stats-less legacy blocks
+still read, and blocks compacted through the zero-decode verbatim
+relocation path carry correct zone maps.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tempo_tpu.backend import LocalBackend, TypedBackend
+from tempo_tpu.encoding import from_version
+from tempo_tpu.encoding.common import BlockConfig, SearchRequest
+from tempo_tpu.encoding.vtpu import format as fmt
+from tempo_tpu.encoding.vtpu.colcache import shared_cache
+from tempo_tpu.model import synth
+from tempo_tpu.traceql.ast_nodes import Condition, FetchSpec
+
+ENC = from_version("vtpu1")
+
+
+def _clustered_batch(seed: int, n_traces: int = 240, spans: int = 4):
+    """A batch whose services/names/attr keys CLUSTER by trace order, so
+    small row groups get distinct presence sets and pruning has teeth
+    (uniform synth data puts every code in every row group)."""
+    rng = np.random.default_rng(seed)
+    b = synth.make_batch(n_traces, spans, seed=seed)
+    d = b.dictionary
+    n = b.num_spans
+    svc = [d.add(s) for s in ("alpha", "beta", "gamma", "delta")]
+    names = [d.add(s) for s in ("op-a", "op-b", "op-c", "op-d")]
+    keys = [d.add(s) for s in ("zone-key-a", "zone-key-b")]
+    third = n // 3
+    service = b.cols["service"].copy()
+    name = b.cols["name"].copy()
+    service[:third] = svc[0]
+    service[third : 2 * third] = svc[1]
+    service[2 * third :] = rng.choice(svc[2:], size=n - 2 * third)
+    name[:third] = rng.choice(names[:2], size=third)
+    name[third:] = rng.choice(names[2:], size=n - third)
+    b.cols["service"] = service
+    b.cols["name"] = name
+    # durations cluster too: first third short, rest long
+    dur = b.cols["duration_nano"].copy()
+    dur[:third] = rng.integers(10**3, 10**5, size=third).astype(np.uint64)
+    dur[third:] = rng.integers(10**7, 10**9, size=n - third).astype(np.uint64)
+    b.cols["duration_nano"] = dur
+    # one attr key only in the first third's spans
+    akey = b.attrs["attr_key"].copy()
+    owner = b.attrs["attr_span"]
+    akey[owner < third] = keys[0]
+    akey[owner >= third] = keys[1]
+    b.attrs["attr_key"] = akey
+    return b
+
+
+@pytest.fixture
+def block(tmp_path):
+    backend = TypedBackend(LocalBackend(str(tmp_path)))
+    cfg = BlockConfig(row_group_spans=128)  # many row groups per block
+    meta = ENC.create_block([_clustered_batch(7)], "t", backend, cfg)
+    return meta, backend, cfg
+
+
+def _open(meta, backend, cfg):
+    blk = ENC.open_block(meta, backend, cfg)
+    cache = shared_cache()
+    if cache is not None:
+        cache.clear()  # each arm pays its own IO
+    return blk
+
+
+def _hits(resp):
+    return sorted(t.trace_id_hex for t in resp.traces)
+
+
+SEARCHES = [
+    SearchRequest(tags={"service": "alpha"}, limit=0),
+    SearchRequest(tags={"service": "delta"}, limit=0),
+    SearchRequest(tags={"service": "cart"}, limit=0),  # synth-wide service
+    SearchRequest(tags={"name": "op-c"}, limit=0),
+    SearchRequest(tags={"zone-key-a": "v1"}, limit=0),
+    SearchRequest(tags={"service": "alpha"}, min_duration_ns=10**6, limit=0),
+    SearchRequest(max_duration_ns=10**4, limit=0),
+]
+
+FETCHES = [
+    FetchSpec([Condition("any", "service.name", "=", "alpha")]),
+    FetchSpec([Condition("any", "service.name", "=~", "al.*")]),
+    FetchSpec([Condition("any", "service.name", "!=", "alpha")]),
+    FetchSpec([Condition("intrinsic", "name", "!~", "op-.*")]),
+    FetchSpec([Condition("intrinsic", "name", "=~", "op-[ab]")]),
+    FetchSpec([Condition("intrinsic", "duration", ">", 10**6)]),
+    FetchSpec([Condition("intrinsic", "duration", "<", 10**4)]),
+    FetchSpec([Condition("any", "zone-key-a", "=", "v1")]),
+    FetchSpec([Condition("any", "zone-key-a", "!=", "v1")]),
+    FetchSpec(
+        [
+            Condition("any", "service.name", "=", "alpha"),
+            Condition("intrinsic", "duration", ">", 10**6),
+        ]
+    ),
+    FetchSpec(
+        [
+            Condition("any", "service.name", "=", "delta"),
+            Condition("intrinsic", "name", "=~", "op-[ab]"),
+        ],
+        all_conditions=False,
+    ),
+]
+
+
+class TestPrunedParity:
+    def test_search_parity_and_economy(self, block, monkeypatch):
+        meta, backend, cfg = block
+        for req in SEARCHES:
+            blk = _open(meta, backend, cfg)
+            pruned = blk.search(req)
+            monkeypatch.setenv("TEMPO_TPU_ZONEMAPS", "0")
+            blk2 = _open(meta, backend, cfg)
+            unpruned = blk2.search(req)
+            monkeypatch.delenv("TEMPO_TPU_ZONEMAPS")
+            assert _hits(pruned) == _hits(unpruned), req
+            assert unpruned.pruned_row_groups == 0
+            if pruned.pruned_row_groups:
+                assert pruned.inspected_bytes < unpruned.inspected_bytes, req
+        # the clustered layout must actually exercise pruning somewhere
+        blk = _open(meta, backend, cfg)
+        selective = blk.search(SearchRequest(tags={"service": "alpha"}, limit=0))
+        assert selective.pruned_row_groups > 0
+
+    def test_fetch_parity_including_negations(self, block, monkeypatch):
+        meta, backend, cfg = block
+        for spec in FETCHES:
+            blk = _open(meta, backend, cfg)
+            pruned = sorted(t.trace_id.hex() for t in blk.fetch_candidates(spec))
+            monkeypatch.setenv("TEMPO_TPU_ZONEMAPS", "0")
+            blk2 = _open(meta, backend, cfg)
+            unpruned = sorted(t.trace_id.hex() for t in blk2.fetch_candidates(spec))
+            monkeypatch.delenv("TEMPO_TPU_ZONEMAPS")
+            assert pruned == unpruned, spec
+
+    def test_negated_ops_never_prune(self, block):
+        """!=/!~ presence-set pruning would be unsound: a span whose code
+        is ABSENT from the presence set is exactly the one that matches.
+        The resolvers for negated ops must not carry a prune hook."""
+        from tempo_tpu.encoding.vtpu.block import _lower_condition
+
+        meta, backend, cfg = block
+        d = ENC.open_block(meta, backend, cfg).dictionary()
+        for cond in (
+            Condition("any", "service.name", "!=", "alpha"),
+            Condition("any", "service.name", "!~", "al.*"),
+            Condition("intrinsic", "name", "!=", "op-a"),
+        ):
+            r = _lower_condition(cond, d)
+            assert callable(r)
+            assert getattr(r, "prune", None) is None
+
+    def test_randomized_parity(self, tmp_path, monkeypatch):
+        backend = TypedBackend(LocalBackend(str(tmp_path)))
+        cfg = BlockConfig(row_group_spans=64)
+        rng = np.random.default_rng(11)
+        for seed in (1, 2, 3):
+            meta = ENC.create_block([_clustered_batch(seed, n_traces=120)], "t", backend, cfg)
+            svcs = ["alpha", "beta", "gamma", "delta", "cart", "frontend", "missing"]
+            for _ in range(8):
+                req = SearchRequest(tags={"service": str(rng.choice(svcs))}, limit=0)
+                if rng.random() < 0.4:
+                    req.min_duration_ns = int(rng.integers(10**3, 10**8))
+                blk = _open(meta, backend, cfg)
+                a = _hits(blk.search(req))
+                monkeypatch.setenv("TEMPO_TPU_ZONEMAPS", "0")
+                b = _hits(_open(meta, backend, cfg).search(req))
+                monkeypatch.delenv("TEMPO_TPU_ZONEMAPS")
+                assert a == b, (seed, req)
+
+
+class TestFormatCompat:
+    def test_stats_roundtrip(self):
+        b = _clustered_batch(3, n_traces=40)
+        payload, rg = fmt.serialize_row_group(b, 0, b.num_spans, 0, "none")
+        assert rg.stats["duration_nano"][0] <= rg.stats["duration_nano"][1]
+        assert set(rg.stats) >= {"start_unix_nano", "duration_nano", "service", "name"}
+        back = fmt.RowGroupMeta.from_json(rg.to_json())
+        assert back.stats == rg.stats
+
+    def test_legacy_statsless_block_still_searches(self, block):
+        """Blocks written before stats existed must read + search: strip
+        stats from the on-disk index and re-open."""
+        from tempo_tpu.backend.base import ColumnIndexName
+
+        meta, backend, cfg = block
+        blk = _open(meta, backend, cfg)
+        want = _hits(blk.search(SearchRequest(tags={"service": "alpha"}, limit=0)))
+
+        idx = fmt.BlockIndex.from_bytes(
+            backend.read_named(meta.tenant_id, meta.block_id, ColumnIndexName))
+        for rg in idx.row_groups:
+            rg.stats = {}
+        backend.write_named(meta, ColumnIndexName, idx.to_bytes())
+
+        legacy = _open(meta, backend, cfg)
+        resp = legacy.search(SearchRequest(tags={"service": "alpha"}, limit=0))
+        assert _hits(resp) == want
+        assert resp.pruned_row_groups == 0  # unknown stats never prune
+
+    def test_large_code_sets_omitted_not_truncated(self):
+        cols = {"name": np.arange(fmt.MAX_STAT_CODES + 1, dtype=np.uint32),
+                "service": np.arange(4, dtype=np.uint32)}
+        stats = fmt.compute_stats(cols)
+        assert "name" not in stats  # truncation would prune real matches
+        assert stats["service"] == [0, 1, 2, 3]
+
+
+class TestRelocationStats:
+    def _disjoint_metas(self, backend, cfg):
+        from tempo_tpu.encoding.vtpu.compactor import remap_codes
+        from tempo_tpu.model.columnar import Dictionary
+
+        metas = []
+        for j, high in enumerate((False, True)):
+            b = _clustered_batch(20 + j, n_traces=100)
+            tid = b.cols["trace_id"].copy()
+            if high:
+                tid[:, 0] |= np.uint32(0x80000000)
+                # shift this block's dictionary codes so compaction's
+                # remap is NON-identity: relocation must push code
+                # columns through the lazy gather and recompute their
+                # stats in the output code space (copying the input code
+                # sets would be silently unsound)
+                shifted = Dictionary(["", "pad-a", "pad-b", "pad-c"])
+                remap = b.dictionary.remap_onto(shifted)
+                remap_codes(remap, b.cols, b.attrs)
+                b = type(b)(cols=b.cols, attrs=b.attrs, dictionary=shifted)
+            else:
+                tid[:, 0] &= np.uint32(0x7FFFFFFF)
+            b.cols["trace_id"] = tid
+            metas.append(ENC.create_block([b.sorted_by_trace()], "t", backend, cfg))
+        return metas
+
+    def _recomputed_stats(self, blk, rg):
+        cols = blk.read_columns(
+            rg, [c for c in fmt.STATS_NUMERIC + fmt.STATS_CODES if c in rg.pages])
+        return fmt.compute_stats(cols)
+
+    def test_zero_decode_relocation_carries_correct_stats(self, tmp_path):
+        from tempo_tpu.encoding.common import CompactionOptions
+        from tempo_tpu.encoding.vtpu.compactor import VtpuCompactor
+
+        backend = TypedBackend(LocalBackend(str(tmp_path)))
+        cfg = BlockConfig(row_group_spans=128)
+        metas = self._disjoint_metas(backend, cfg)
+        comp = VtpuCompactor(CompactionOptions(block_config=cfg, zero_decode=True))
+        (out,) = comp.compact(metas, "t", backend)
+        assert comp.pages_copied_verbatim > 0  # the fast path actually ran
+
+        blk = ENC.open_block(out, backend, cfg)
+        checked = 0
+        for rg in blk.index().row_groups:
+            want = self._recomputed_stats(blk, rg)
+            assert rg.stats == want
+            checked += 1
+        assert checked > 1
+
+    def test_statsless_inputs_gain_stats_on_compaction(self, tmp_path):
+        """Legacy inputs (no stats in the index) compacted through the
+        verbatim-relocation path come out WITH correct zone maps."""
+        from tempo_tpu.backend.base import ColumnIndexName
+        from tempo_tpu.encoding.common import CompactionOptions
+        from tempo_tpu.encoding.vtpu.compactor import VtpuCompactor
+
+        backend = TypedBackend(LocalBackend(str(tmp_path)))
+        cfg = BlockConfig(row_group_spans=128)
+        metas = self._disjoint_metas(backend, cfg)
+        for m in metas:  # simulate pre-stats blocks
+            idx = fmt.BlockIndex.from_bytes(
+                backend.read_named(m.tenant_id, m.block_id, ColumnIndexName))
+            for rg in idx.row_groups:
+                rg.stats = {}
+            backend.write_named(m, ColumnIndexName, idx.to_bytes())
+
+        comp = VtpuCompactor(CompactionOptions(block_config=cfg, zero_decode=True))
+        (out,) = comp.compact(metas, "t", backend)
+        assert comp.pages_copied_verbatim > 0
+
+        blk = ENC.open_block(out, backend, cfg)
+        for rg in blk.index().row_groups:
+            assert rg.stats == self._recomputed_stats(blk, rg)

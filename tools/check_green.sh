@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Tier-1 green gate: run ROADMAP.md's verify command and fail on ANY
+# test failure or error. Snapshots must run this before committing —
+# round 5 shipped two committed-broken tests because nothing gated the
+# tree on its own suite.
+#
+# Exit code: pytest's own (nonzero on any F/E, including collection
+# errors). The DOTS_PASSED line mirrors the driver's pass-count metric.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+  2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+if [ "$rc" -ne 0 ]; then
+  echo "check_green: RED (pytest exit $rc)" >&2
+else
+  echo "check_green: green" >&2
+fi
+exit "$rc"
