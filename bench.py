@@ -312,6 +312,97 @@ def _search_rep(reps: int = 3) -> dict:
         tmp.cleanup()
 
 
+def _metrics_rep(reps: int = 3) -> dict:
+    """TraceQL metrics rep: `| rate()` + `| quantile_over_time()` over a
+    compacted multi-block store, device (Pallas segmented bincount) vs
+    host-numpy arms on identical data. Parity is asserted (all reduction
+    paths must agree bit-for-bit) and the zone-map economy is checked:
+    the selective rate query's inspectedBytes with pruning armed must
+    stay below the unpruned arm's."""
+    from tempo_tpu.backend import LocalBackend, TypedBackend
+    from tempo_tpu.encoding import from_version
+    from tempo_tpu.encoding.common import BlockConfig
+    from tempo_tpu.encoding.vtpu.colcache import shared_cache
+    from tempo_tpu.metrics_engine import (
+        HostAccumulator,
+        compile_metrics_plan,
+        evaluate_block,
+        make_accumulator,
+    )
+
+    enc = from_version("vtpu1")
+    tmp = tempfile.TemporaryDirectory(dir=_bench_dir())
+    try:
+        backend = TypedBackend(LocalBackend(tmp.name))
+        cfg = BlockConfig(row_group_spans=2048)
+        # reuse the search rep's corpus: a needle service isolated to one
+        # row group of one block + everything in every dictionary, so
+        # pruning must come from presence sets, not dictionary misses
+        metas = _search_inputs(backend, cfg)
+        start, end, step = 1_700_000_000, 1_700_000_060, 10
+        queries = {
+            "rate": "{ resource.service.name = `needle-svc` } | rate() by (name)",
+            "quantile": "{} | quantile_over_time(duration, 0.5, 0.99)",
+        }
+
+        def run_once(q: str, device: bool, zonemaps: bool) -> "HostAccumulator":
+            cache = shared_cache()
+            if cache is not None:
+                cache.clear()  # every run pays its own IO
+            os.environ["TEMPO_TPU_ZONEMAPS"] = "1" if zonemaps else "0"
+            try:
+                plan = compile_metrics_plan(q, start, end, step)
+                acc = make_accumulator(plan, device=device)
+                for m in metas:
+                    blk = enc.open_block(m, backend, cfg)
+                    evaluate_block(plan, blk, acc)
+                    acc.stats["inspectedBytes"] += blk.bytes_read
+                acc.merged_counts()  # drain device buffers inside the clock
+                return acc
+            finally:
+                os.environ.pop("TEMPO_TPU_ZONEMAPS", None)
+
+        out: dict = {}
+        parity_all = True
+        for qname, q in queries.items():
+            arms: dict[str, dict] = {}
+            counts: dict[str, np.ndarray] = {}
+            for arm, device in (("device", True), ("host", False)):
+                run_once(q, device, True)  # warmup: jit compiles + page cache
+                times = []
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    acc = run_once(q, device, True)
+                    times.append(time.perf_counter() - t0)
+                arms[arm] = {"s": float(np.median(times)),
+                             "bytes": acc.stats["inspectedBytes"]}
+                counts[arm] = acc.merged_counts()
+            unpruned = run_once(q, False, False)
+            parity = bool(
+                (counts["device"] == counts["host"]).all()
+                and (counts["host"] == unpruned.merged_counts()).all()
+            )
+            parity_all = parity_all and parity
+            if not parity:
+                print(f"[bench] WARNING: metrics rep {qname!r} arms DISAGREE",
+                      file=sys.stderr)
+            out[qname] = {
+                "device_s": round(arms["device"]["s"], 4),
+                "host_s": round(arms["host"]["s"], 4),
+                "inspected_bytes": arms["host"]["bytes"],
+                "inspected_bytes_unpruned": unpruned.stats["inspectedBytes"],
+                "bytes_ratio": round(
+                    unpruned.stats["inspectedBytes"] / max(arms["host"]["bytes"], 1), 3),
+                "parity": parity,
+            }
+        r = out["rate"]
+        out["pruning_ok"] = bool(r["inspected_bytes"] < r["inspected_bytes_unpruned"])
+        out["parity"] = parity_all
+        return out
+    finally:
+        tmp.cleanup()
+
+
 class Arm:
     """One benchmark configuration: owns its backend + inputs; runs one
     timed rep on demand; verifies recall at the end."""
@@ -529,6 +620,7 @@ def main():
         "cpu_native_times_s": [],
         "fastpath": None,
         "search": None,
+        "metrics": None,
     }
     dog = _watchdog(float(os.environ.get("BENCH_TIMEOUT_S", "2700")), partial)
     try:
@@ -627,6 +719,12 @@ def _run(dog, partial: dict):
     partial["search"] = search_rep
     print(f"[bench] search: {search_rep}", file=sys.stderr)
 
+    # TraceQL metrics: rate + quantile over the same store, device vs
+    # host reduction arms (ISSUE 5 tentpole)
+    metrics_rep = _metrics_rep()
+    partial["metrics"] = metrics_rep
+    print(f"[bench] metrics: {metrics_rep}", file=sys.stderr)
+
     med, spread = _stats(tpu_times)
     blocks_per_s = B_BLOCKS / med
     # paired per-rep ratios: epoch noise hits both arms of a pair, so the
@@ -669,6 +767,7 @@ def _run(dog, partial: dict):
         "pages_reencoded": tpu_arm.pages_reencoded,
         "fastpath": fastpath,
         "search": search_rep,
+        "metrics": metrics_rep,
     }))
 
 

@@ -21,6 +21,7 @@ PATH_TRACES = "/api/traces"  # + /{traceID}
 PATH_SEARCH = "/api/search"
 PATH_SEARCH_TAGS = "/api/search/tags"
 PATH_SEARCH_TAG_VALUES = "/api/search/tag"  # + /{name}/values
+PATH_METRICS_QUERY_RANGE = "/api/metrics/query_range"
 PATH_ECHO = "/api/echo"
 
 _DUR_RE = re.compile(r"([0-9]*\.?[0-9]+)(ns|us|µs|ms|s|m|h)")
@@ -90,6 +91,44 @@ def parse_logfmt_tags(s: str) -> dict:
     return tags
 
 
+def parse_time_range(start, end, step=None, *, require_range: bool = False,
+                     now_s: int | None = None,
+                     default_window_s: int = 3600) -> tuple[int, int, int]:
+    """Shared start/end/step validation for search and query_range.
+
+    start/end are unix seconds, step in seconds; all accept str or int.
+    Inverted ranges are rejected (BadRequest -> 400) instead of
+    silently returning empty. With require_range=True (query_range) the
+    range is mandatory and defaulted — end=now, start=end-1h, step
+    sized to ~120 points — and step must be positive; without it
+    (search) 0 means unbounded and step is not defaulted.
+    """
+    import time as _time
+
+    try:
+        start_s = int(start or 0)
+        end_s = int(end or 0)
+        step_s = int(step or 0)
+    except (TypeError, ValueError) as e:
+        raise BadRequest(f"invalid time range: {e}") from None
+    if start_s < 0 or end_s < 0 or step_s < 0:
+        raise BadRequest("start/end/step must be non-negative")
+    if require_range:
+        if not end_s:
+            end_s = int(now_s if now_s is not None else _time.time())
+        if not start_s:
+            start_s = end_s - default_window_s
+        if start_s < 0:
+            raise BadRequest("start must be non-negative")
+        if not step_s:
+            step_s = max(1, (end_s - start_s) // 120)
+        if step_s <= 0:
+            raise BadRequest("step must be positive")
+    if start_s and end_s and end_s <= start_s:
+        raise BadRequest("http parameter start must be before end")
+    return start_s, end_s, step_s
+
+
 def _first(qs: dict, key: str, default: str = "") -> str:
     v = qs.get(key)
     if v is None:
@@ -134,16 +173,15 @@ def parse_search_request(qs: dict) -> SearchRequest:
     req.max_duration_ns = parse_duration_ns(_first(qs, "maxDuration"))
     if req.max_duration_ns and req.min_duration_ns > req.max_duration_ns:
         raise BadRequest("invalid maxDuration: must be greater than minDuration")
+    req.start_seconds, req.end_seconds, _ = parse_time_range(
+        _first(qs, "start", "0"), _first(qs, "end", "0")
+    )
     try:
-        req.start_seconds = int(_first(qs, "start", "0"))
-        req.end_seconds = int(_first(qs, "end", "0"))
         req.limit = int(_first(qs, "limit", "20"))
     except ValueError as e:
         raise BadRequest(str(e)) from None
     if req.limit <= 0:
         raise BadRequest("invalid limit: must be a positive number")
-    if req.start_seconds and req.end_seconds and req.end_seconds <= req.start_seconds:
-        raise BadRequest("http parameter start must be before end")
     return req
 
 
@@ -205,6 +243,56 @@ def build_search_block_params(req: SearchBlockRequest) -> dict:
     if req.size_bytes:
         qs["size"] = str(req.size_bytes)
     return qs
+
+
+@dataclass
+class QueryRangeRequest:
+    """One /api/metrics/query_range request (reference: api.QueryRangeRequest
+    — q, start, end, step, plus engine knobs)."""
+
+    query: str = ""
+    start_s: int = 0
+    end_s: int = 0
+    step_s: int = 0
+    max_series: int = 64
+    exemplars: int = 0
+
+
+def parse_query_range_request(qs: dict, now_s: int | None = None) -> QueryRangeRequest:
+    """q + start/end (unix seconds) + step (seconds or Go duration).
+    Range is mandatory-with-defaults and validated by parse_time_range."""
+    req = QueryRangeRequest()
+    req.query = _first(qs, "q") or _first(qs, "query")
+    if not req.query:
+        raise BadRequest("q is required")
+    step_raw = _first(qs, "step")
+    step_s = 0
+    if step_raw:
+        if step_raw.lstrip("-").isdigit():
+            step_s = int(step_raw)
+        else:
+            ns = parse_duration_ns(step_raw)
+            step_s = ns // 10**9
+            if ns and not step_s:
+                raise BadRequest("step must be at least 1s")
+        if step_s <= 0:
+            # explicit zero/negative step is a client error (the
+            # Prometheus API contract); only an ABSENT step defaults
+            raise BadRequest("step must be positive")
+    req.start_s, req.end_s, req.step_s = parse_time_range(
+        _first(qs, "start", "0"), _first(qs, "end", "0"), step_s,
+        require_range=True, now_s=now_s,
+    )
+    try:
+        req.max_series = int(_first(qs, "maxSeries", "64"))
+        req.exemplars = int(_first(qs, "exemplars", "0"))
+    except ValueError as e:
+        raise BadRequest(str(e)) from None
+    if req.max_series <= 0:
+        raise BadRequest("maxSeries must be positive")
+    if req.exemplars < 0:
+        raise BadRequest("exemplars must be non-negative")
+    return req
 
 
 def parse_trace_id(path_tail: str) -> bytes:

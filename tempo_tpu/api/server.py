@@ -16,6 +16,7 @@ Routes:
   GET  /api/search           tag search (tags=logfmt) or TraceQL (q=...)
   GET  /api/search/tags      tag names in recent data
   GET  /api/search/tag/{n}/values
+  GET  /api/metrics/query_range   TraceQL metrics (Prometheus matrix)
   GET  /api/echo             frontend liveness ("echo")
   GET  /ready /metrics /status[/config|/services|/endpoints|/buildinfo]
 """
@@ -280,6 +281,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._trace_by_id(path[len(api_params.PATH_TRACES) + 1 :], qs)
         if path == api_params.PATH_SEARCH:
             return self._search(qs)
+        if path == api_params.PATH_METRICS_QUERY_RANGE:
+            return self._query_range(qs)
         if path == api_params.PATH_SEARCH_TAGS:
             self._send_json(200, {"tagNames": app.search_tags(org_id=self._org_id())})
             return 200
@@ -451,6 +454,34 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(200, otlp.encode_traces_json([trace]))
         return 200
 
+    def _query_range(self, qs: dict) -> int:
+        """TraceQL metrics: Prometheus-compatible query_range matrix
+        (reference: api.PathMetricsQueryRange + the Prometheus HTTP API
+        response envelope, so Grafana's Prometheus datasource can graph
+        it directly)."""
+        req = api_params.parse_query_range_request(qs)
+        t0 = time.monotonic()
+        try:
+            doc = self.app.query_range(
+                req.query, req.start_s, req.end_s, req.step_s,
+                org_id=self._org_id(), max_series=req.max_series,
+                exemplars=req.exemplars,
+            )
+        except ValueError as e:
+            # the metrics planner's contract: ValueError = range/size
+            # problem, a client error end to end
+            raise BadRequest(str(e)) from e
+        stats = doc.pop("stats", {})
+        stats["elapsedMs"] = int((time.monotonic() - t0) * 1000)
+        stats["inspectedBytes"] = str(stats.get("inspectedBytes", 0))
+        self._send_json(200, {
+            "status": "success",
+            "data": {"resultType": doc["resultType"], "result": doc["result"]},
+            "exemplars": doc.get("exemplars", []),
+            "metrics": stats,
+        })
+        return 200
+
     def _search(self, qs: dict) -> int:
         req = api_params.parse_search_request(qs)
         org = self._org_id()
@@ -499,6 +530,7 @@ _ENDPOINTS = [
     "GET /api/search",
     "GET /api/search/tags",
     "GET /api/search/tag/{name}/values",
+    "GET /api/metrics/query_range",
     "GET /api/echo",
     "GET /ready",
     "GET /metrics",

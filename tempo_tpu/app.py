@@ -337,6 +337,14 @@ class App:
             self.resolve_tenant(org_id), query, **kw
         )
 
+    def query_range(self, query: str, start_s: int, end_s: int, step_s: int,
+                    org_id=None, max_series: int = 64, exemplars: int = 0) -> dict:
+        """TraceQL metrics (`{...} | rate() ...`) as a Prometheus matrix."""
+        return self._require(self.frontend, "queries").query_range(
+            self.resolve_tenant(org_id), query, start_s, end_s, step_s,
+            max_series=max_series, exemplars=exemplars,
+        )
+
     def search_tags(self, org_id=None) -> list[str]:
         """Reference: /api/search/tags is proxied by the frontend straight
         to queriers (no sharding middleware)."""

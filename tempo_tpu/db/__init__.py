@@ -177,6 +177,19 @@ class TempoDB:
                 self._mesh_searcher = MeshSearcher(mesh, self.cfg.block.bucket_for)
         return self._mesh_searcher or None
 
+    def mesh_metrics_evaluator(self):
+        """Lazy sharded query_range evaluator (None without a mesh) —
+        the metrics analog of mesh_searcher."""
+        if getattr(self, "_mesh_metrics", None) is None:
+            mesh = self.compaction_mesh()
+            if mesh is None:
+                self._mesh_metrics = False
+            else:
+                from tempo_tpu.parallel.metrics import MeshMetricsEvaluator
+
+                self._mesh_metrics = MeshMetricsEvaluator(mesh, self.cfg.block.bucket_for)
+        return self._mesh_metrics or None
+
     # ------------------------------------------------------------------
     # writer
     # ------------------------------------------------------------------

@@ -1,0 +1,30 @@
+"""TraceQL metrics engine — range-vector queries over stored blocks.
+
+Reference: Tempo's TraceQL metrics (`{...} | rate() by (...)` etc. —
+modules/frontend query_range sharding + the traceql metrics evaluator)
+rebuilt on this engine's columnar read path: span filters evaluate as
+vectorized column scans (traceql/vector.py), span start times bucket
+into step bins, and every aggregate reduces to ONE segmented bincount
+over a combined (series, time-bin[, histogram-bucket]) slot index —
+host numpy by default, the Pallas kernel (ops/pallas_kernels.
+seg_bincount) on a single device, and a shard_map + psum reduction
+across the mesh (parallel/metrics.py). Counts are integers and merge by
+addition, so shard partials combine exactly (bit-identical at any
+shard count) — the same mergeability contract the HLL/count-min
+sketches follow (ops/sketch.py; quantiles ride the fixed-bucket
+log-scale HistogramPlan added there).
+"""
+
+from tempo_tpu.metrics_engine.evaluate import (  # noqa: F401
+    HostAccumulator,
+    DeviceAccumulator,
+    SeriesTable,
+    eval_batch,
+    evaluate_block,
+    finalize_matrix,
+    make_accumulator,
+    merge_wire,
+    new_wire,
+    wire_stats_merge,
+)
+from tempo_tpu.metrics_engine.plan import MetricsPlan, compile_metrics_plan  # noqa: F401

@@ -217,6 +217,84 @@ class Frontend:
         return out
 
     # ------------------------------------------------------------------
+    def query_range(self, tenant: str, query: str, start_s: int, end_s: int,
+                    step_s: int, max_series: int = 64, exemplars: int = 0) -> dict:
+        """TraceQL metrics over [start, end) at step resolution
+        (reference: the frontend's query_range sharder — time-range
+        shards over backend blocks + a recent-window job served from
+        ingester live data, modules/frontend metrics middleware).
+
+        The full range is compiled once up front (client errors fail
+        before any job is sharded), then split into step-ALIGNED
+        sub-windows — each worker evaluates a sub-plan whose bins map
+        back into the parent grid by a pure offset, so partials merge by
+        integer addition and shard boundaries can never change results.
+        The recent job covers the whole window from ingester live/WAL
+        segments (the not-yet-flushed tail); block jobs cover flushed
+        data, the same disjointness contract the search path uses.
+        """
+        from tempo_tpu.metrics_engine import (
+            compile_metrics_plan,
+            finalize_matrix,
+            merge_wire,
+            new_wire,
+        )
+
+        plan = compile_metrics_plan(query, start_s, end_s, step_s,
+                                    max_series=max_series, exemplars=exemplars)
+        common = {"q": query, "step": plan.step_s,
+                  "max_series": max_series, "exemplars": exemplars}
+
+        descs = []
+        now = time.time()
+        if plan.end_s >= now - self.cfg.query_ingesters_until_s:
+            descs.append({"kind": "metrics_recent", "start": plan.start_s,
+                          "end": plan.end_s, **common})
+
+        # step-aligned time-range shards, blocks chunked per shard by the
+        # same byte budget the search sharder uses
+        n_shards = max(1, min(self.cfg.query_shards, plan.n_bins))
+        bins_per = -(-plan.n_bins // n_shards)  # ceil
+        metas = self.db.blocklist.metas(tenant)
+        b = 0
+        while b < plan.n_bins:
+            w0 = plan.start_s + b * plan.step_s
+            w1 = min(plan.end_s, plan.start_s + (b + bins_per) * plan.step_s)
+            b += bins_per
+            group, size = [], 0
+            for m in metas:
+                if m.end_time < w0 or m.start_time > w1:
+                    continue
+                group.append(m.block_id)
+                size += max(m.size_bytes, 1)
+                if size >= self.cfg.target_bytes_per_job:
+                    descs.append({"kind": "metrics_blocks", "block_ids": group,
+                                  "start": w0, "end": w1, **common})
+                    group, size = [], 0
+            if group:
+                descs.append({"kind": "metrics_blocks", "block_ids": group,
+                              "start": w0, "end": w1, **common})
+
+        results, errors = self._run_jobs(tenant, descs)
+        if errors:
+            # a failed shard is a hole in the range vector; fail the
+            # query rather than return silently wrong rates
+            raise errors[0]
+        merged = new_wire()
+        for r in results:
+            off = (int(r.get("start", plan.start_s)) - plan.start_s) // plan.step_s
+            merge_wire(merged, r.get("wire", {}), plan, bin_offset=off)
+        if len(results) > 1 and merged["stats"].get("seriesDropped"):
+            # each shard caps series in its own first-seen order, so a
+            # series kept by one shard and dropped by another would read
+            # as silent zero bins — same contract as a failed shard above
+            raise ValueError(
+                f"query exceeds max_series={max_series} on at least one "
+                "shard; narrow the filter or raise max_series"
+            )
+        return finalize_matrix(plan, merged)
+
+    # ------------------------------------------------------------------
     def traceql(self, tenant: str, query: str, start_s=0, end_s=0, limit=20,
                 stats: dict | None = None):
         # parse up front: a malformed query is a client error and must

@@ -19,6 +19,24 @@ class Sample:
     labels: tuple  # ((k, v), ...)
     value: float
     timestamp_ms: int = 0
+    exemplar: "Exemplar | None" = None
+
+
+@dataclass
+class Exemplar:
+    """One trace-ID exemplar attached to a series (reference:
+    registry histogram exemplars — the (trace_id, value, ts) triple a
+    Grafana heatmap uses to jump from a bucket to the trace). The same
+    struct travels in query_range responses (metrics_engine), so both
+    metric surfaces speak one exemplar shape."""
+
+    trace_id: str  # hex
+    value: float
+    timestamp_ms: int = 0
+
+    def to_dict(self) -> dict:
+        return {"traceID": self.trace_id, "value": self.value,
+                "timestamp": self.timestamp_ms}
 
 
 class ManagedRegistry:
@@ -56,9 +74,12 @@ class ManagedRegistry:
 
     def observe_histogram(self, name: str, labels: tuple, bounds: list,
                           bucket_counts, total_sum: float, total_count: int,
-                          now: float | None = None) -> None:
+                          now: float | None = None,
+                          exemplar: Exemplar | None = None) -> None:
         """Batch-observe: pre-aggregated bucket counts from a vectorized
-        pass (the processors hand whole batches, not single points)."""
+        pass (the processors hand whole batches, not single points).
+        An optional trace-ID exemplar rides along; the latest one per
+        series is kept (the Prometheus client convention)."""
         now = now or time.time()
         key = (name, labels)
         with self.lock:
@@ -68,13 +89,16 @@ class ManagedRegistry:
                 if not self._can_add(len(self.counters) + len(self.histograms)):
                     self.series_dropped += 1
                     return
-                h = {"buckets": [0] * (len(bounds) + 1), "sum": 0.0, "count": 0, "last": now}
+                h = {"buckets": [0] * (len(bounds) + 1), "sum": 0.0, "count": 0,
+                     "last": now, "exemplar": None}
                 self.histograms[key] = h
             for i, c in enumerate(bucket_counts):
                 h["buckets"][i] += int(c)
             h["sum"] += float(total_sum)
             h["count"] += int(total_count)
             h["last"] = now
+            if exemplar is not None:
+                h["exemplar"] = exemplar
 
     # ------------------------------------------------------------------
     def remove_stale(self, now: float | None = None) -> int:
@@ -104,7 +128,10 @@ class ManagedRegistry:
                     cum += h["buckets"][i]
                     out.append(Sample(f"{name}_bucket", labels + (("le", str(b)),), cum, now_ms))
                 cum += h["buckets"][-1]
-                out.append(Sample(f"{name}_bucket", labels + (("le", "+Inf"),), cum, now_ms))
+                # exemplar rides the +Inf bucket (contains every value),
+                # the OpenMetrics exposition convention
+                out.append(Sample(f"{name}_bucket", labels + (("le", "+Inf"),), cum,
+                                  now_ms, exemplar=h.get("exemplar")))
                 out.append(Sample(f"{name}_sum", labels, h["sum"], now_ms))
                 out.append(Sample(f"{name}_count", labels, h["count"], now_ms))
         return out
@@ -114,5 +141,11 @@ class ManagedRegistry:
         for s in self.collect():
             labels = list(s.labels) + [("tenant", self.tenant)]
             lbl = ",".join(f'{k}="{v}"' for k, v in labels)
-            lines.append(f"{s.name}{{{lbl}}} {s.value}")
+            line = f"{s.name}{{{lbl}}} {s.value}"
+            if s.exemplar is not None:
+                # OpenMetrics exemplar suffix: `# {labels} value timestamp`
+                ex = s.exemplar
+                line += (f' # {{trace_id="{ex.trace_id}"}} {ex.value}'
+                         f" {ex.timestamp_ms / 1000:.3f}")
+            lines.append(line)
         return "\n".join(lines) + ("\n" if lines else "")

@@ -12,6 +12,9 @@ from __future__ import annotations
 
 import numpy as np
 
+from tempo_tpu.encoding.vtpu import format as fmt
+from tempo_tpu.modules.generator.registry import Exemplar
+
 # seconds buckets matching the reference's default latency histogram
 DEFAULT_BOUNDS = [0.002, 0.004, 0.008, 0.016, 0.032, 0.064, 0.128, 0.256, 0.512, 1.02, 2.05, 4.10]
 
@@ -44,7 +47,9 @@ class SpanMetricsProcessor:
             [c["service"].astype(np.uint64), c["name"].astype(np.uint64),
              c["kind"].astype(np.uint64), c["status_code"].astype(np.uint64)], axis=1
         )
-        uniq, inverse = np.unique(keys, axis=0, return_inverse=True)
+        uniq, first_row, inverse = np.unique(
+            keys, axis=0, return_index=True, return_inverse=True
+        )
         counts = np.bincount(inverse, minlength=len(uniq))
         secs = c["duration_nano"].astype(np.float64) / 1e9
         sums = np.bincount(inverse, weights=secs, minlength=len(uniq))
@@ -67,6 +72,14 @@ class SpanMetricsProcessor:
             )
             self.registry.inc_counter(CALLS, labels, float(counts[g]))
             self.registry.inc_counter(SIZE, labels, float(sizes[g]))
+            # one representative span of the group as the trace exemplar
+            r = int(first_row[g])
+            ex = Exemplar(
+                trace_id=fmt.id_to_hex(c["trace_id"][r]),
+                value=float(secs[r]),
+                timestamp_ms=int(c["start_unix_nano"][r]) // 10**6,
+            )
             self.registry.observe_histogram(
-                LATENCY, labels, self.bounds, bucket_counts[g], float(sums[g]), int(counts[g])
+                LATENCY, labels, self.bounds, bucket_counts[g], float(sums[g]),
+                int(counts[g]), exemplar=ex,
             )

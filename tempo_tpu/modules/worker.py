@@ -10,11 +10,16 @@ deployments use the same broker with local workers, so single-binary and
 microservice modes run identical code paths.
 
 Descriptor kinds:
-  find          {trace_id, mode, block_start, block_end}
-  search_recent {search}
-  search_blocks {block_ids, search}
-  traceql       {q, start, end, limit}
-Results are JSON-safe dicts; traces travel as b64 OTLP protobuf.
+  find           {trace_id, mode, block_start, block_end}
+  search_recent  {search}
+  search_blocks  {block_ids, search}
+  traceql        {q, start, end, limit}
+  metrics_recent {q, start, end, step, max_series, exemplars}
+  metrics_blocks {block_ids, q, start, end, step, max_series, exemplars}
+Results are JSON-safe dicts; traces travel as b64 OTLP protobuf;
+metrics partials travel in HostAccumulator.to_wire form (sparse
+per-series bin counts + exemplars + stats) tagged with the job's
+window start so the frontend can offset bins into the parent grid.
 """
 
 from __future__ import annotations
@@ -54,6 +59,17 @@ def execute_job(querier, tenant: str, desc: dict) -> dict:
         req = SearchRequest.from_dict(desc["search"])
         resp = querier.search_block_batch(tenant, desc["block_ids"], req)
         return {"response": resp.to_dict()}
+    if kind in ("metrics_recent", "metrics_blocks"):
+        kw = dict(
+            start_s=desc["start"], end_s=desc["end"], step_s=desc["step"],
+            max_series=desc.get("max_series", 64),
+            exemplars=desc.get("exemplars", 0),
+        )
+        if kind == "metrics_recent":
+            wire = querier.query_range_recent(tenant, desc["q"], **kw)
+        else:
+            wire = querier.query_range_blocks(tenant, desc["block_ids"], desc["q"], **kw)
+        return {"wire": wire, "start": desc["start"]}
     if kind == "traceql":
         stats: dict = {}
         hits = querier.traceql(

@@ -49,13 +49,18 @@ def _build() -> str | None:
         return so
     tmp = f"{so}.{os.getpid()}.tmp"  # pid-suffixed: concurrent first-use
     # builds from sibling processes must not interleave into one file
-    cmd = [
-        "g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-        _SRC, "-o", tmp, "-lzstd", "-lz",
-    ]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-    except Exception:
+    base = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+            _SRC, "-o", tmp, "-lz"]
+    # images without the libzstd dev symlink still carry the runtime;
+    # -l:libzstd.so.1 links it directly (codec.cc declares the ABI)
+    for zstd_flag in ("-lzstd", "-l:libzstd.so.1"):
+        try:
+            subprocess.run(base + [zstd_flag], check=True,
+                           capture_output=True, timeout=120)
+            break
+        except Exception:
+            continue
+    else:
         return so if os.path.exists(so) else None  # a sibling may have won
     os.replace(tmp, so)
     # drop stale builds

@@ -18,7 +18,24 @@
 #include <new>
 
 #include <zlib.h>
+#if defined(__has_include) && __has_include(<zstd.h>)
 #include <zstd.h>
+#else
+// Some images ship the zstd runtime (libzstd.so.1) without the dev
+// header. The handful of entry points used below have had a stable ABI
+// since zstd 1.3, so declare them directly and let the loader bind.
+extern "C" {
+size_t ZSTD_compressBound(size_t srcSize);
+size_t ZSTD_compress(void* dst, size_t dstCapacity, const void* src,
+                     size_t srcSize, int compressionLevel);
+size_t ZSTD_decompress(void* dst, size_t dstCapacity, const void* src,
+                       size_t compressedSize);
+unsigned ZSTD_isError(size_t code);
+unsigned long long ZSTD_getFrameContentSize(const void* src, size_t srcSize);
+}
+#define ZSTD_CONTENTSIZE_UNKNOWN (0ULL - 1)
+#define ZSTD_CONTENTSIZE_ERROR (0ULL - 2)
+#endif
 
 extern "C" {
 

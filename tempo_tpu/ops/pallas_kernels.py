@@ -182,6 +182,100 @@ def _range_call(hi: jnp.ndarray, lo: jnp.ndarray, bounds: jnp.ndarray, interpret
     return out.reshape(N)
 
 
+# ---------------------------------------------------------------------------
+# segmented bincount (the TraceQL metrics reduction)
+# ---------------------------------------------------------------------------
+
+_BC_ROWS = 256  # span rows folded per grid step (bounds the one-hot tile)
+_BC_MAX_SLOTS = 1 << 15  # widest slot vector the VMEM one-hot tile carries
+# (256 x 32768 f32 = 32 MiB streamed tile-by-tile; wider falls back to host)
+
+
+def _bincount_kernel(slots_ref, out_ref):
+    """Accumulate one row tile into the slot counts.
+
+    slots_ref: (_BC_ROWS, 1) int32 in VMEM — combined slot index per
+    span row ((series*bins + bin) [*buckets + bucket]); negative = drop.
+    out_ref: (1, S) f32 — running counts, same block every grid step
+    (the TPU grid is sequential, so += accumulation is well-defined).
+
+    The histogram is computed as a one-hot matmul: rows compare against
+    a lane iota to build the (rows, S) one-hot tile, and a (1, rows) x
+    (rows, S) dot folds it — scatter-free, which is the shape the MXU
+    wants (SQL-on-compressed-data aggregates reduce the same way).
+    """
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    slots = slots_ref[...]  # (R, 1) int32
+    S = out_ref.shape[1]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
+    one_hot = (slots == iota).astype(jnp.float32)  # (R, S); negatives match nothing
+    ones = jnp.ones((1, slots.shape[0]), jnp.float32)
+    out_ref[...] += jax.lax.dot_general(
+        ones, one_hot, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("n_slots_pad", "interpret"))
+def _bincount_call(slots: jnp.ndarray, n_slots_pad: int, interpret: bool):
+    """slots: (N,) int32, N a multiple of _BC_ROWS -> (n_slots_pad,) f32."""
+    N = slots.shape[0]
+    out = pl.pallas_call(
+        _bincount_kernel,
+        out_shape=jax.ShapeDtypeStruct((1, n_slots_pad), jnp.float32),
+        grid=(N // _BC_ROWS,),
+        in_specs=[
+            pl.BlockSpec((_BC_ROWS, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, n_slots_pad), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(slots.reshape(N, 1))
+    return out.reshape(n_slots_pad)
+
+
+def seg_bincount(slots: np.ndarray, n_slots: int) -> np.ndarray:
+    """Count occurrences of each slot id in [0, n_slots): the device
+    reduction behind `| rate()` / `| quantile_over_time()` — span rows
+    carry a combined (series, time-bin[, histogram-bucket]) slot index
+    and the counts vector IS the range-vector partial (mergeable by
+    addition, so mesh shards psum it). Negative slot ids are dropped
+    (masked spans / out-of-window bins). Returns (n_slots,) int64.
+
+    Counts are exact below 2**24 per slot (f32 accumulation of unit
+    increments); one dispatch covers at most a few million spans, far
+    inside that bound.
+    """
+    n = slots.shape[0]
+    if n == 0:
+        # a zero-step grid never runs _init, leaving out_ref undefined
+        return np.zeros(n_slots, np.int64)
+    n_pad = ((n + _BC_ROWS - 1) // _BC_ROWS) * _BC_ROWS
+    padded = np.full(n_pad, -1, np.int32)
+    padded[:n] = slots.astype(np.int32)
+    s_pad = 128
+    while s_pad < n_slots:
+        s_pad <<= 1  # pow2 widths bound the jit cache
+    if s_pad > _BC_MAX_SLOTS:
+        # the one-hot tile is (_BC_ROWS, s_pad) f32 in VMEM; past this
+        # width it stops fitting (and the MXU win is gone anyway —
+        # giant sparse slot spaces are bincount-bound, not matmul-bound)
+        return np.bincount(padded[padded >= 0], minlength=n_slots).astype(np.int64)[:n_slots]
+    if not _use_pallas():
+        # negative ids would wrap under jnp indexing; the exact host
+        # mirror is a masked bincount
+        out = np.bincount(padded[padded >= 0], minlength=s_pad).astype(np.int64)
+    else:
+        out = np.asarray(
+            _bincount_call(jnp.asarray(padded), s_pad, _interpret())
+        ).astype(np.int64)
+    return out[:n_slots]
+
+
 def u64_range_scan(values: np.ndarray, lo_bound: int, hi_bound: int, n_pad: int) -> jnp.ndarray:
     """lo_bound <= values <= hi_bound over uint64 values, evaluated on
     device as paired uint32 limbs (duration predicates; reference:

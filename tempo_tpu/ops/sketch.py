@@ -146,6 +146,104 @@ def cm_query(counts: jnp.ndarray, limbs: jnp.ndarray, p: CMPlan) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# fixed-bucket log-scale histogram (quantile sketch)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class HistogramPlan:
+    """Log-linear fixed-bucket histogram over positive values (the
+    TraceQL metrics quantile sketch; same family as HDR histograms).
+
+    Octaves [2**min_exp, 2**max_exp), each split into `sub` equal-width
+    sub-buckets, plus an underflow bucket (v < 2**min_exp, including
+    v <= 0) and an overflow bucket. Bucket edges are exact binary
+    fractions resolved with integer frexp arithmetic, so host numpy and
+    device jnp bucketize identically; the relative width of any finite
+    bucket is <= 1/sub, which bounds quantile error to one bucket width.
+
+    Counts merge with elementwise add -> `psum` over ICI combines shard
+    partials EXACTLY (integer adds commute), the property the mesh
+    metrics path relies on for shard-count invariance.
+    """
+
+    min_exp: int = 10  # 2**10 ns ~ 1us: floor for duration-type values
+    max_exp: int = 42  # 2**42 ns ~ 73min: ceiling
+    sub: int = 8  # sub-buckets per octave
+
+    def __post_init__(self):
+        if self.max_exp <= self.min_exp:
+            raise ValueError("HistogramPlan: max_exp must exceed min_exp")
+        if self.sub < 1:
+            raise ValueError("HistogramPlan: sub must be >= 1")
+
+    @property
+    def n_buckets(self) -> int:
+        return (self.max_exp - self.min_exp) * self.sub + 2
+
+    def np_bucket_of(self, values: np.ndarray) -> np.ndarray:
+        """(N,) float/int values -> (N,) int32 bucket indices (host)."""
+        v = np.asarray(values, np.float64)
+        m, e = np.frexp(np.maximum(v, 1e-300))  # v = m * 2**e, m in [0.5, 1)
+        octave = e - 1  # v in [2**octave, 2**(octave+1))
+        subidx = np.minimum((2.0 * m - 1.0) * self.sub, self.sub - 1).astype(np.int64)
+        idx = (octave - self.min_exp) * self.sub + subidx + 1
+        idx = np.where(v < float(2.0 ** self.min_exp), 0, idx)
+        return np.minimum(idx, self.n_buckets - 1).astype(np.int32)
+
+    def bucket_upper(self, idx) -> np.ndarray:
+        """Upper edge of each bucket (quantile read-out point; the
+        underflow bucket reports the floor, overflow the ceiling)."""
+        idx = np.asarray(idx, np.int64)
+        k = np.clip(idx - 1, 0, (self.max_exp - self.min_exp) * self.sub - 1)
+        octave, s = k // self.sub, k % self.sub
+        upper = np.exp2(self.min_exp + octave) * (1.0 + (s + 1) / self.sub)
+        upper = np.where(idx <= 0, float(2.0 ** self.min_exp), upper)
+        return np.where(idx >= self.n_buckets - 1, float(2.0 ** self.max_exp), upper)
+
+
+def hist_init(p: HistogramPlan) -> jnp.ndarray:
+    return jnp.zeros((p.n_buckets,), dtype=jnp.uint32)
+
+
+@partial(jax.jit, static_argnames=("p",))
+def hist_update(counts: jnp.ndarray, values: jnp.ndarray, p: HistogramPlan,
+                valid: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Scatter-add a batch of values into the bucket counts (device
+    mirror of np_bucket_of — same frexp arithmetic, same edges)."""
+    v = values.astype(jnp.float32)
+    m, e = jnp.frexp(jnp.maximum(v, jnp.float32(1e-30)))
+    octave = e.astype(jnp.int32) - 1
+    subidx = jnp.minimum((2.0 * m - 1.0) * p.sub, p.sub - 1).astype(jnp.int32)
+    idx = (octave - p.min_exp) * p.sub + subidx + 1
+    idx = jnp.where(v < jnp.float32(2.0 ** p.min_exp), 0, idx)
+    idx = jnp.minimum(idx, p.n_buckets - 1)
+    if valid is not None:
+        idx = jnp.where(valid, idx, p.n_buckets)  # OOB + drop mode
+    return counts.at[idx].add(jnp.uint32(1), mode="drop")
+
+
+@jax.jit
+def hist_merge(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return a + b
+
+
+def np_hist_quantile(counts: np.ndarray, qs, p: HistogramPlan) -> np.ndarray:
+    """Quantile read-out: the upper edge of the first bucket whose
+    cumulative count reaches ceil(q * total). Error <= one bucket width
+    (relative <= 1/sub for in-range values). counts: (n_buckets,);
+    returns (len(qs),) float64, NaN when the histogram is empty."""
+    c = np.asarray(counts, np.int64)
+    total = int(c.sum())
+    qs = np.asarray(list(qs), np.float64)
+    if total == 0:
+        return np.full(qs.shape, np.nan)
+    ranks = np.maximum(np.ceil(qs * total), 1)
+    idx = np.searchsorted(np.cumsum(c), ranks)
+    return p.bucket_upper(np.minimum(idx, p.n_buckets - 1))
+
+
+# ---------------------------------------------------------------------------
 # numpy mirrors for verification
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,162 @@
+"""Mesh-sharded TraceQL metrics: row-group slot batches fanned across
+devices, counts psum-merged over ICI.
+
+Mirrors parallel/search.py (P4): up to W*R (block, row-group) units
+stack on the mesh per dispatch, every device bincounts its shard's
+combined (series, bin, bucket) slot ids, and `psum` over the range axis
+folds the partials — the same collective the compactor's HLL/count-min
+sketches ride, legal here because metric counts are integers that merge
+by addition (ops/sketch.py HistogramPlan contract). The result is
+bit-identical to the host path at ANY shard count: sharding moves
+where the adds happen, never what they sum to.
+
+Host-side work per unit stays what the host path pays (column decode +
+filter mask + slot computation); the device amortizes the reduction
+across many row groups per dispatch, which is what makes the device
+road viable at all (a per-row-group dispatch loses 600:1 through the
+dispatch tunnel — PERF.md, search read-path section).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tempo_tpu.parallel.mesh import RANGE_AXIS, WINDOW_AXIS, shard_map_compat
+from tempo_tpu.parallel.search import _dispatch_lock
+
+log = logging.getLogger(__name__)
+
+
+@lru_cache(maxsize=32)
+def make_sharded_bincount(mesh, n_slots: int):
+    """Jitted sharded segmented bincount.
+
+    Inputs (stacked over the (W, R) mesh axes):
+      slots (W, R, N) int32 — combined slot id per span row; -1 = drop
+    Returns:
+      counts (W, n_slots) int32 — per-window totals, psum-merged over
+      the range axis (replicated across range shards post-collective)
+    """
+
+    def local(slots):
+        idx = jnp.where(slots >= 0, slots, n_slots)  # OOB + drop mode
+        counts = jnp.zeros((n_slots,), jnp.int32).at[idx].add(
+            jnp.int32(1), mode="drop"
+        )
+        return jax.lax.psum(counts, RANGE_AXIS)
+
+    def step(slots):
+        return local(slots[0, 0])[None]
+
+    return jax.jit(
+        shard_map_compat(
+            step,
+            mesh=mesh,
+            in_specs=(P(WINDOW_AXIS, RANGE_AXIS),),
+            out_specs=P(WINDOW_AXIS),
+        )
+    )
+
+
+class MeshMetricsEvaluator:
+    """Mesh-sharded multi-block metrics evaluation (the query_range
+    analog of MeshSearcher). Feeds a HostAccumulator: counts come from
+    the mesh reduction, exemplars/series bookkeeping stay host-side."""
+
+    def __init__(self, mesh, bucket_for):
+        self.mesh = mesh
+        self.w = mesh.shape[WINDOW_AXIS]
+        self.r = mesh.shape[RANGE_AXIS]
+        self.bucket_for = bucket_for
+        self.last_stats: dict = {}
+
+    def evaluate_blocks(self, blocks, plan, acc) -> None:
+        """blocks: iterable of lazily-opened VtpuBackendBlocks. Row
+        groups are zone-map/time pruned with zero reads, surviving units
+        evaluate host-side to slot ids, and slot batches dispatch in
+        stacked (W, R) chunks under the process-wide mesh lock."""
+        from tempo_tpu.encoding.vtpu.block import (
+            pruned_row_groups_total,
+            zone_maps_enabled,
+        )
+        from tempo_tpu.metrics_engine.evaluate import (
+            _lower_prunes,
+            eval_batch,
+            rg_prunes,
+        )
+        from tempo_tpu.model.columnar import ATTR_COLUMNS, _empty_cols
+        from tempo_tpu.traceql import vector
+
+        stats = self.last_stats = {"dispatches": 0, "units": 0, "h2d_bytes": 0}
+        zm = zone_maps_enabled()
+        all_conds = plan.pipeline.conditions().all_conditions
+        cap = self.w * self.r
+        scan = make_sharded_bincount(self.mesh, plan.n_slots)
+        pending: list[np.ndarray] = []
+        opened: list = []
+
+        def flush():
+            if not pending:
+                return
+            pad = self.bucket_for(max(len(s) for s in pending))
+            stacked = np.full((cap, pad), -1, np.int32)
+            for i, s in enumerate(pending):
+                stacked[i, : len(s)] = s
+            with _dispatch_lock:
+                out = scan(jnp.asarray(stacked.reshape(self.w, self.r, pad)))
+                counts = np.asarray(out).sum(axis=0, dtype=np.int64)
+            acc.counts += counts
+            stats["dispatches"] += 1
+            stats["units"] += len(pending)
+            stats["h2d_bytes"] += stacked.nbytes
+            pending.clear()
+
+        for blk in blocks:
+            opened.append(blk)
+            acc.stats["inspectedBlocks"] += 1
+            try:
+                d = blk.dictionary()
+                resolvers, impossible = _lower_prunes(plan, d)
+                if impossible:
+                    continue
+                row_groups = list(blk.index().row_groups)
+            except Exception as e:  # deleted mid-query: skip, like search
+                log.warning("mesh metrics: block %s unreadable: %s",
+                            blk.meta.block_id, e)
+                continue
+            for rg in row_groups:
+                if rg.end_s < plan.start_s or rg.start_s > plan.end_s:
+                    continue
+                if zm and resolvers and rg_prunes(plan, rg, resolvers, all_conds):
+                    acc.stats["prunedRowGroups"] += 1
+                    blk.pruned_row_groups += 1
+                    pruned_row_groups_total.inc()
+                    continue
+                try:
+                    cols = blk.read_columns(rg, list(plan.span_cols))
+                    attrs = (
+                        blk.read_columns(rg, list(ATTR_COLUMNS))
+                        if plan.needs_attrs
+                        else _empty_cols(ATTR_COLUMNS)
+                    )
+                except Exception as e:
+                    log.warning("mesh metrics: column load failed: %s", e)
+                    continue
+                view = vector.ColumnView(cols, attrs, rg.n_spans)
+                res = eval_batch(plan, view, d, acc.series)
+                acc.stats["inspectedSpans"] += rg.n_spans
+                acc.observe_exemplars(res, view)
+                live = res.slots[res.slots >= 0].astype(np.int32)
+                if len(live):
+                    pending.append(live)
+                    if len(pending) >= cap:
+                        flush()
+        flush()
+        acc.stats["inspectedBytes"] += sum(b.bytes_read for b in opened)

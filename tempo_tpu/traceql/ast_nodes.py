@@ -377,11 +377,45 @@ def validate(pipeline: "Pipeline") -> None:
             static_type(stage.expr)
             if not _references_span(stage.expr):
                 raise TypeError_("by() must reference the span")
+        elif isinstance(stage, MetricsAggregate):
+            walk_metrics(stage)
         elif isinstance(stage, Pipeline):
             for s in stage.stages:
                 walk(s)
         # Coalesce / Select need no checks (Select's parser already
         # restricts arguments to field nodes)
+
+    def walk_metrics(stage: MetricsAggregate):
+        if stage.func not in METRICS_FUNCS:
+            raise TypeError_(f"unknown metrics function {stage.func}")
+        if stage.value_expr is not None:
+            t = static_type(stage.value_expr)
+            if t not in ("number", "unknown"):
+                raise TypeError_(f"{stage.func}() requires a numeric field, got {t}")
+            if not _references_span(stage.value_expr):
+                raise TypeError_(f"{stage.func}() must reference the span")
+        for q in stage.qs:
+            if not (0.0 < float(q) <= 1.0):
+                raise TypeError_(f"quantile {q} outside (0, 1]")
+        if stage.by_expr is not None:
+            static_type(stage.by_expr)
+            if not _references_span(stage.by_expr):
+                raise TypeError_("by() must reference the span")
+
+    # a metrics stage turns the whole pipeline into a range-vector
+    # query: it must be the FINAL stage, appear once, and follow only
+    # spanset expressions (the reference's grammar encodes the same
+    # shape — spansetPipeline PIPE metricsAggregation)
+    metrics_idx = [i for i, s in enumerate(pipeline.stages)
+                   if isinstance(s, MetricsAggregate)]
+    if metrics_idx:
+        if len(metrics_idx) > 1 or metrics_idx[0] != len(pipeline.stages) - 1:
+            raise TypeError_("metrics stage must be the single final pipeline stage")
+        for s in pipeline.stages[:-1]:
+            if not isinstance(s, (SpansetFilter, SpansetOp)):
+                raise TypeError_(
+                    "metrics stage can only follow spanset filter stages"
+                )
 
     walk(pipeline)
 
@@ -471,6 +505,29 @@ class AggregateFilter:
 class Coalesce:
     def conditions(self) -> FetchSpec:
         return FetchSpec(conditions=[], all_conditions=True)
+
+
+METRICS_FUNCS = ("rate", "count_over_time", "quantile_over_time", "histogram_over_time")
+
+
+@dataclass
+class MetricsAggregate:
+    """Terminal metrics pipeline stage — `| rate() by (...)`,
+    `| count_over_time()`, `| quantile_over_time(attr, q...)`,
+    `| histogram_over_time(attr)` (reference: the TraceQL metrics
+    grammar, pkg/traceql/expr.y metricsAggregation + ast.go
+    MetricsAggregate). Spanset engines never evaluate this node; the
+    metrics engine (tempo_tpu/metrics_engine) compiles it to a
+    time-bucketed segmented reduction over stored blocks."""
+
+    func: str  # one of METRICS_FUNCS
+    value_expr: Expr | None = None  # measured field (quantile/histogram)
+    qs: tuple = ()  # quantiles for quantile_over_time
+    by_expr: Expr | None = None  # `by (...)` grouping field
+
+
+def is_metrics_pipeline(pipeline: "Pipeline") -> bool:
+    return any(isinstance(s, MetricsAggregate) for s in pipeline.stages)
 
 
 @dataclass

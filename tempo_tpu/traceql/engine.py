@@ -198,6 +198,14 @@ class Engine:
     def execute(self, query: str, fetch, start_s: int = 0, end_s: int = 0,
                 limit: int = 20) -> list[SpansetResult]:
         pipeline = parse(query)
+        if A.is_metrics_pipeline(pipeline):
+            # range-vector queries have their own evaluator + endpoint;
+            # surfacing as ParseError keeps the HTTP mapping a 400
+            from tempo_tpu.traceql.parser import ParseError
+
+            raise ParseError(
+                "metrics queries (| rate() ...) must use /api/metrics/query_range"
+            )
         spec = pipeline.conditions()
         results = []
         for trace in fetch(spec, start_s, end_s):

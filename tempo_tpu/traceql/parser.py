@@ -127,6 +127,8 @@ class Parser:
                 if not isinstance(e, (A.Attribute, A.Intrinsic)):
                     raise ParseError("select() takes attribute or intrinsic fields")
             return A.Select(exprs)
+        if t.kind == "ident" and t.text in A.METRICS_FUNCS:
+            return self._parse_metrics_stage()
         if t.kind == "keyword" and t.text in AGG_NAMES:
             self.next()
             self.expect("op", "(")
@@ -141,6 +143,33 @@ class Parser:
             rhs = self.parse_literal()
             return A.AggregateFilter(t.text, fe, op_t.text, rhs)
         raise ParseError(f"unknown pipeline stage at {t.pos}: {t.text!r}")
+
+    def _parse_metrics_stage(self):
+        """`| rate() [by (expr)]`, `| count_over_time() [by (...)]`,
+        `| quantile_over_time(field, q, ...) [by (...)]`,
+        `| histogram_over_time(field) [by (...)]` (reference:
+        metricsAggregation, pkg/traceql/expr.y)."""
+        func = self.next().text
+        self.expect("op", "(")
+        value_expr = None
+        qs: list[float] = []
+        if func in ("quantile_over_time", "histogram_over_time"):
+            value_expr = self.parse_field_expr()
+            if func == "quantile_over_time":
+                while self.accept("op", ","):
+                    lit = self.parse_literal()
+                    if lit.kind not in ("int", "float"):
+                        raise ParseError(f"quantile must be a number, got {lit.kind}")
+                    qs.append(float(lit.value))
+                if not qs:
+                    raise ParseError("quantile_over_time() needs at least one quantile")
+        self.expect("op", ")")
+        by_expr = None
+        if self.accept("keyword", "by"):
+            self.expect("op", "(")
+            by_expr = self.parse_field_expr()
+            self.expect("op", ")")
+        return A.MetricsAggregate(func, value_expr, tuple(qs), by_expr)
 
     # -- field expression precedence climb -------------------------------
     def parse_field_expr(self):

@@ -10,6 +10,9 @@ Implements the reference-derived benchmark configurations:
       tempodb/compactor_test.go BenchmarkCompaction:696).
   (4) search   — multi-block tag search + bloom-gated find-by-ID over a
       multi-tenant blockset (BASELINE config 4, scaled to fit the box).
+  (6) metrics  — TraceQL metrics query_range (rate by service +
+      duration quantiles) over the same multi-tenant blockset (ISSUE 5;
+      no reference analog — the metrics engine is new here).
 
 Each subcommand prints one JSON object with timings, throughput and
 recall stats. `python tools/bench_suite.py all` runs every config.
@@ -169,9 +172,57 @@ def bench_search(n_tenants: int = 3, blocks_per_tenant: int = 6,
         }
 
 
+def bench_metrics(n_tenants: int = 2, blocks_per_tenant: int = 4,
+                  traces_per_block: int = 2000) -> dict:
+    """Config 6 (ISSUE 5): TraceQL metrics query_range over a
+    multi-tenant multi-block store — rate-by-service + duration
+    quantiles straight off stored blocks via the metrics engine."""
+    from tempo_tpu.metrics_engine import (
+        compile_metrics_plan,
+        evaluate_block,
+        make_accumulator,
+    )
+    from tempo_tpu.model import synth
+
+    with tempfile.TemporaryDirectory() as tmp:
+        db = _db(tmp)
+        total_spans = 0
+        for ti in range(n_tenants):
+            for b in range(blocks_per_tenant):
+                batch = synth.make_batch(traces_per_block, 8, seed=ti * 100 + b)
+                total_spans += batch.num_spans
+                db.write_batch(f"tenant-{ti}", batch)
+        db.poll_now()
+
+        queries = {
+            "rate": "{} | rate() by (resource.service.name)",
+            "quantile": "{} | quantile_over_time(duration, 0.5, 0.99)",
+        }
+        out = {"config": "traceql_metrics", "tenants": n_tenants,
+               "blocks": n_tenants * blocks_per_tenant, "total_spans": total_spans}
+        start, end, step = 1_700_000_000, 1_700_000_060, 10
+        for qname, q in queries.items():
+            t0 = time.perf_counter()
+            series = inspected = 0
+            for ti in range(n_tenants):
+                tenant = f"tenant-{ti}"
+                plan = compile_metrics_plan(q, start, end, step)
+                acc = make_accumulator(plan, device=False)
+                for m in db.blocklist.metas(tenant):
+                    blk = db.encoding_for(m.version).open_block(m, db.backend, db.cfg.block)
+                    evaluate_block(plan, blk, acc)
+                    acc.stats["inspectedBytes"] += blk.bytes_read
+                series += len(acc.series.slots)
+                inspected += acc.stats["inspectedBytes"]
+            out[f"{qname}_s"] = round(time.perf_counter() - t0, 3)
+            out[f"{qname}_series"] = series
+            out[f"{qname}_inspected_bytes"] = inspected
+        return out
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("config", choices=["ingest", "sweep", "search", "all"])
+    ap.add_argument("config", choices=["ingest", "sweep", "search", "metrics", "all"])
     args = ap.parse_args()
     # dead-tunnel guard: probe device init with a timeout BEFORE any jax
     # import; a hung tunnel degrades the run to CPU (tagged) instead of
@@ -188,7 +239,8 @@ def main():
         "ingest": [bench_ingest],
         "sweep": [bench_sweep],
         "search": [bench_search],
-        "all": [bench_ingest, bench_sweep, bench_search],
+        "metrics": [bench_metrics],
+        "all": [bench_ingest, bench_sweep, bench_search, bench_metrics],
     }[args.config]
     for fn in runs:
         out = fn()

@@ -318,6 +318,44 @@ def receiver_sweep(dist_url: str, query_url: str, grpc_port: int = 0) -> dict:
     return results
 
 
+def query_range_probe(query_url: str, n: int = 10) -> dict:
+    """--query-range arm: drive /api/metrics/query_range against the
+    freshly-loaded cluster (rate by service over the last 5 minutes,
+    1s step) and require every request to return a well-formed matrix.
+    Run AFTER the write load so the ingester live/WAL tail has data."""
+    import urllib.parse
+
+    end = int(time.time())
+    qs = urllib.parse.urlencode({
+        "q": "{} | rate() by (resource.service.name)",
+        "start": end - 300, "end": end, "step": 1,
+    })
+    lat, ok, series = [], 0, 0
+    for _ in range(n):
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(
+                f"{query_url}/api/metrics/query_range?{qs}", timeout=30
+            ) as r:
+                doc = json.loads(r.read())
+            if (r.status == 200 and doc.get("status") == "success"
+                    and doc["data"]["resultType"] == "matrix"):
+                ok += 1
+                series = max(series, len(doc["data"]["result"]))
+        except (urllib.error.URLError, OSError, KeyError, ValueError):
+            pass
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    return {
+        "requests": n,
+        "ok": ok,
+        "series": series,
+        "p50_s": round(lat[len(lat) // 2], 3),
+        "max_s": round(lat[-1], 3),
+        "passed": bool(ok == n and series > 0),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--url", help="existing cluster URL (skips spawning)")
@@ -326,6 +364,9 @@ def main() -> int:
     ap.add_argument("--readers", type=int, default=2)
     ap.add_argument("--spans-per-trace", type=int, default=5)
     ap.add_argument("--skip-sweep", action="store_true")
+    ap.add_argument("--query-range", action="store_true",
+                    help="probe /api/metrics/query_range after the load "
+                         "and gate on matrix responses")
     args = ap.parse_args()
 
     procs: list[Proc] = []
@@ -373,6 +414,11 @@ def main() -> int:
         )
         summary["receiver_sweep"] = sweep
         sweep_ok = all(v in ("ok", "skipped") for v in sweep.values()) if sweep else True
+        if args.query_range:
+            qr = query_range_probe(query_url)
+            print(f"[loadtest] query_range probe: {qr}", file=sys.stderr)
+            summary["query_range"] = qr
+            sweep_ok = sweep_ok and qr["passed"]
         summary["passed"] = bool(summary["passed"] and sweep_ok)
         print(json.dumps(summary))
         return 0 if summary["passed"] else 1
