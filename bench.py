@@ -609,6 +609,15 @@ def main():
         child_server()
         return
 
+    # faults-off guard: perf numbers must measure the real path. A chaos
+    # plan left armed in the environment would silently skew (or crash)
+    # every rep, so refuse to run rather than emit a poisoned artifact.
+    if os.environ.get("TEMPO_TPU_FAULTS", "").strip():
+        print("bench.py: refusing to run with TEMPO_TPU_FAULTS armed "
+              f"({os.environ['TEMPO_TPU_FAULTS']!r}) — unset it; perf reps "
+              "must measure the fault-free path", file=sys.stderr)
+        sys.exit(2)
+
     # partial state every failure artifact (crash OR watchdog) reports.
     # ALL keys pre-created: the watchdog thread iterates this dict in
     # fire(); assignment to existing keys never resizes it, so the

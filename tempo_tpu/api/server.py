@@ -475,7 +475,10 @@ class _Handler(BaseHTTPRequestHandler):
         stats["elapsedMs"] = int((time.monotonic() - t0) * 1000)
         stats["inspectedBytes"] = str(stats.get("inspectedBytes", 0))
         self._send_json(200, {
-            "status": "success",
+            # "partial" when terminal shard failures stayed within the
+            # tenant's failed-shard budget (stats.failedShards says how
+            # many); "success" otherwise
+            "status": doc.pop("status", "success"),
             "data": {"resultType": doc["resultType"], "result": doc["result"]},
             "exemplars": doc.get("exemplars", []),
             "metrics": stats,

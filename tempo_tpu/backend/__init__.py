@@ -15,6 +15,11 @@ from tempo_tpu.backend.base import (  # noqa: F401
     RawBackend,
     TypedBackend,
 )
+from tempo_tpu.backend.faults import (  # noqa: F401
+    FaultInjectingBackend,
+    FaultPlan,
+    retryable_error,
+)
 from tempo_tpu.backend.local import LocalBackend  # noqa: F401
 from tempo_tpu.backend.mock import MockBackend  # noqa: F401
 
@@ -22,7 +27,30 @@ from tempo_tpu.backend.mock import MockBackend  # noqa: F401
 def make_raw_backend(kind: str, options: dict | None = None) -> RawBackend:
     """Backend factory (reference: tempodb.New backend selection,
     tempodb/tempodb.go:133-170). Cloud backends are imported lazily so
-    the common local/mock path stays dependency-free."""
+    the common local/mock path stays dependency-free.
+
+    TEMPO_TPU_FAULTS (e.g. "read=0.01,corrupt=0.001,seed=7") wraps the
+    result in a FaultInjectingBackend — the operator chaos knob; see
+    backend/faults.py. bench.py refuses to run with it armed."""
+    return _maybe_inject_faults(_make_raw_backend(kind, options))
+
+
+def _maybe_inject_faults(raw: RawBackend) -> RawBackend:
+    from tempo_tpu.backend import faults
+
+    plan = faults.env_plan()
+    if plan is not None:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "TEMPO_TPU_FAULTS is armed — backend %s runs behind fault injection",
+            type(raw).__name__,
+        )
+        return FaultInjectingBackend(raw, plan)
+    return raw
+
+
+def _make_raw_backend(kind: str, options: dict | None = None) -> RawBackend:
     options = options or {}
     if kind == "local":
         return LocalBackend(options.get("path", "blocks"))

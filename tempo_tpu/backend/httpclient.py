@@ -23,7 +23,7 @@ import time
 import urllib.parse
 from dataclasses import dataclass
 
-from tempo_tpu.util import metrics
+from tempo_tpu.util import deadline, metrics
 
 hedged_total = metrics.counter(
     "tempo_backend_hedged_roundtrips_total",
@@ -93,6 +93,16 @@ class PooledHTTPClient:
     # -- request execution ----------------------------------------------
     def _once(self, method: str, path: str, headers: dict, body: bytes | None):
         conn = self._get_conn()
+        # bound the socket timeout by the propagated request deadline: a
+        # backend read must not outlive the query that asked for it.
+        # ALWAYS set it — a pooled connection may carry the shortened
+        # timeout of a previous deadlined request, which would spuriously
+        # time out healthy requests that have no (or a long) deadline
+        bounded = (deadline.bound_timeout(self.timeout_s)
+                   if deadline.remaining() is not None else self.timeout_s)
+        conn.timeout = bounded
+        if getattr(conn, "sock", None) is not None:
+            conn.sock.settimeout(bounded)
         try:
             conn.request(method, path, body=body, headers=headers)
             resp = conn.getresponse()
@@ -125,6 +135,7 @@ class PooledHTTPClient:
 
         last: Exception | None = None
         for attempt in range(self.max_retries + 1):
+            deadline.check()  # an exceeded deadline is terminal, not retried
             try:
                 if idempotent and method in ("GET", "HEAD") and self.hedge.hedge_at_s > 0:
                     status, data, h = self._hedged(method, path, headers, body)
@@ -143,26 +154,42 @@ class PooledHTTPClient:
                     raise
                 last = e
             if attempt < self.max_retries:
-                time.sleep(min(0.05 * (2**attempt), 1.0))
+                time.sleep(deadline.bound_timeout(min(0.05 * (2**attempt), 1.0)))
         assert last is not None
         raise last
 
     def _hedged(self, method: str, path: str, headers: dict, body):
-        """First response wins; the straggler is abandoned (its pooled
-        connection is closed by _once's error path or drained later)."""
+        """First SUCCESSFUL response wins; an error surfaces only when
+        every launched attempt has failed. (Taking the first *completed*
+        future would let a fast connection error mask a slower in-flight
+        success — exactly the window hedging exists to cover.) The
+        straggler of a won race is abandoned; its pooled connection is
+        closed by _once's error path or drained later."""
         futs = [self._hedge_pool.submit(self._once, method, path, headers, body)]
-        done, _ = concurrent.futures.wait(futs, timeout=self.hedge.hedge_at_s)
         fired = 1
-        while not done and fired < self.hedge.hedge_up_to:
-            hedged_total.inc()
-            futs.append(self._hedge_pool.submit(self._once, method, path, headers, body))
-            fired += 1
-            done, _ = concurrent.futures.wait(
-                futs, timeout=self.hedge.hedge_at_s, return_when=concurrent.futures.FIRST_COMPLETED
+        pending = set(futs)
+        last_err: Exception | None = None
+        while True:
+            done, pending = concurrent.futures.wait(
+                pending,
+                timeout=self.hedge.hedge_at_s if fired < self.hedge.hedge_up_to else None,
+                return_when=concurrent.futures.FIRST_COMPLETED,
             )
-        done, _ = concurrent.futures.wait(futs, return_when=concurrent.futures.FIRST_COMPLETED)
-        first = next(iter(done))
-        return first.result()
+            for f in done:
+                try:
+                    return f.result()
+                except Exception as e:  # noqa: BLE001 — keep racing others
+                    last_err = e
+            if not pending and fired >= self.hedge.hedge_up_to:
+                assert last_err is not None
+                raise last_err
+            if fired < self.hedge.hedge_up_to:
+                # hedge timer elapsed, or an attempt failed: launch the
+                # next attempt immediately (failure = free hedge trigger)
+                hedged_total.inc()
+                nf = self._hedge_pool.submit(self._once, method, path, headers, body)
+                pending.add(nf)
+                fired += 1
 
     def close(self) -> None:
         with self._lock:

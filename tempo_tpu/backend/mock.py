@@ -1,8 +1,10 @@
 """In-memory backend for tests (reference: tempodb/backend/mocks.go:20-150).
 
-Thread-safe; optionally injects failures for fault testing (the reference
-only kills containers in e2e — injecting at the backend seam gives the
-same coverage in-process).
+Thread-safe. fail_every survives for old tests, but new fault testing
+should wrap a plain MockBackend in backend/faults.FaultInjectingBackend
+— it subsumes fail_every (FaultPlan(fail_every=N)) and adds seeded
+error rates, NotFound flaps, latency spikes, short reads, and bit-flip
+corruption, all reproducible from the plan seed.
 """
 
 from __future__ import annotations

@@ -34,9 +34,15 @@ from tempo_tpu.encoding.common import (
     SearchResponse,
 )
 from tempo_tpu.model.trace import Trace, combine_traces
-from tempo_tpu.util import tracing
+from tempo_tpu.util import metrics, tracing
 
 log = logging.getLogger(__name__)
+
+orphans_swept = metrics.counter(
+    "tempodb_orphan_blocks_swept_total",
+    "Meta-less partial blocks (crash between data and meta.json) deleted "
+    "by the startup/maintenance orphan sweep",
+)
 
 
 @dataclass
@@ -60,6 +66,14 @@ class DBConfig:
     # (encoding/vtpu/compactor.py); 0 = all local devices when more than
     # one is attached, 1 = force single-device/host merge
     compaction_device_shards: int = 0
+    # failure-domain hardening (backend/faults.py taxonomy):
+    # consecutive read failures before a block is quarantined (skipped by
+    # queries + compaction; checksum failures count double)
+    quarantine_threshold: int = 3
+    # meta-less partial blocks (a crash between data.bin and meta.json)
+    # are deleted by sweep_orphans once they stay meta-less this long —
+    # long enough that no healthy in-flight write is still mid-block
+    orphan_grace_s: float = 900.0
 
 
 class TempoDB:
@@ -97,7 +111,9 @@ class TempoDB:
                 self._cache_client = cache_client
                 raw_backend = CachedBackend(raw_backend, cache_client)
         self.backend = TypedBackend(raw_backend)
-        self.blocklist = Blocklist()
+        self.blocklist = Blocklist(quarantine_threshold=cfg.quarantine_threshold)
+        self._orphan_seen: dict[tuple[str, str], float] = {}
+        self._orphan_lock = threading.Lock()
         self.pool = JobPool(cfg.pool_workers)
         self.poller = Poller(
             self.backend,
@@ -136,6 +152,49 @@ class TempoDB:
 
     def encoding_for(self, version: str):
         return encoding_registry.from_version(version)
+
+    def block_failure_recorder(self, tenant: str):
+        """Callback feeding the blocklist quarantine: one failed block
+        read, weighted double for checksum failures (definitively the
+        block's fault, where a connection reset may not be). Handed to
+        the mesh search/metrics paths, which attribute errors per block."""
+        from tempo_tpu.encoding.vtpu.codec import CorruptPage
+
+        def record(block_id: str, e: Exception):
+            self.blocklist.record_block_failure(
+                tenant, block_id, f"{type(e).__name__}: {e}",
+                weight=2 if isinstance(e, CorruptPage) else 1,
+            )
+
+        return record
+
+    def block_success_recorder(self, tenant: str):
+        return lambda block_id: self.blocklist.record_block_success(tenant, block_id)
+
+    def guard_block(self, tenant: str, block_id: str, fn, benign: tuple = ()):
+        """Run one block-scoped read job under failure-domain accounting:
+        failures count toward the block's quarantine (checksum failures
+        count double — definitively the block's fault), successes reset
+        the streak. NotFound passes through unweighted (a block deleted
+        by compaction mid-query is a benign race, not a bad block), as
+        do exception types in `benign` (engine bailouts like the
+        vectorized TraceQL path's Unsupported). Transient errors get a
+        short in-place retry (faults.with_retries) before any of that —
+        per-op retries are what let a multi-block query converge under a
+        sustained backend fault rate."""
+        from tempo_tpu.backend.base import NotFound as _NotFound
+        from tempo_tpu.backend.faults import with_retries
+
+        try:
+            out = with_retries(fn)
+        except _NotFound:
+            raise
+        except Exception as e:
+            if not isinstance(e, benign):
+                self.block_failure_recorder(tenant)(block_id, e)
+            raise
+        self.blocklist.record_block_success(tenant, block_id)
+        return out
 
     def default_encoding(self):
         return encoding_registry.from_version(self.cfg.block.version)
@@ -242,11 +301,16 @@ class TempoDB:
             blk = self.encoding_for(meta.version).open_block(meta, self.backend, self.cfg.block)
             return blk.find_trace_by_id(trace_id)
 
-        results, errors = self.pool.run_jobs([lambda m=m: job(m) for m in metas])
-        if errors:
+        results, errors = self.pool.run_jobs(
+            [lambda m=m: self.guard_block(tenant, m.block_id, lambda: job(m)) for m in metas]
+        )
+        fatal = _fatal(errors)
+        if fatal:
             # a failed block read could hide spans of this trace; surface it
-            # rather than return a silently incomplete trace
-            raise errors[0]
+            # rather than return a silently incomplete trace (NotFound is
+            # the benign deleted-by-compaction race: that data lives in
+            # the compaction output, which is also in the list)
+            raise fatal[0]
         return combine_traces([r for r in results if r is not None])
 
     def search(self, tenant: str, req: SearchRequest) -> SearchResponse:
@@ -269,7 +333,11 @@ class TempoDB:
                 self.encoding_for(m.version).open_block(m, self.backend, self.cfg.block)
                 for m in metas
             )  # lazy: blocks past a satisfied limit are never opened
-            return searcher.search_blocks(blocks, req)
+            return searcher.search_blocks(
+                blocks, req,
+                on_block_error=self.block_failure_recorder(tenant),
+                on_block_ok=self.block_success_recorder(tenant),
+            )
         out = SearchResponse()
 
         def job(meta):
@@ -282,9 +350,16 @@ class TempoDB:
             seen_ids.update(t.trace_id_hex for t in r.traces)
             return bool(req.limit) and len(seen_ids) >= req.limit
 
-        results, errors = self.pool.run_jobs([lambda m=m: job(m) for m in metas], stop_when=enough)
-        if errors and not results:
-            raise errors[0]
+        results, errors = self.pool.run_jobs(
+            [lambda m=m: self.guard_block(tenant, m.block_id, lambda: job(m)) for m in metas],
+            stop_when=enough,
+        )
+        fatal = _fatal(errors)
+        if fatal:
+            # strict by design: degradation (partial results within a
+            # failed-shard budget) is the FRONTEND's call, not something
+            # the storage layer silently decides per block
+            raise fatal[0]
         for r in results:
             out.merge(r, limit=req.limit)
         return out
@@ -344,9 +419,13 @@ class TempoDB:
         """Search one specific block (the querier's backend-search job
         unit, reference: modules/querier SearchBlock:432), optionally
         bounded to a row-group subrange (the serverless/page-shard unit)."""
-        meta = self.backend.block_meta(tenant, block_id)
-        blk = self.encoding_for(meta.version).open_block(meta, self.backend, self.cfg.block)
-        return blk.search(req, start_row_group=start_row_group, row_groups=row_groups)
+
+        def run():
+            meta = self.backend.block_meta(tenant, block_id)
+            blk = self.encoding_for(meta.version).open_block(meta, self.backend, self.cfg.block)
+            return blk.search(req, start_row_group=start_row_group, row_groups=row_groups)
+
+        return self.guard_block(tenant, block_id, run)
 
     def fetch_candidates(self, tenant: str, spec, start_s: int = 0, end_s: int = 0,
                          stats: dict | None = None):
@@ -364,9 +443,12 @@ class TempoDB:
                     getattr(blk, "pruned_row_groups", 0),
                     getattr(blk, "coalesced_reads", 0))
 
-        results, errors = self.pool.run_jobs([lambda m=m: job(m) for m in metas])
-        if errors:
-            raise errors[0]
+        results, errors = self.pool.run_jobs(
+            [lambda m=m: self.guard_block(tenant, m.block_id, lambda: job(m)) for m in metas]
+        )
+        fatal = _fatal(errors)
+        if fatal:
+            raise fatal[0]
         by_id: dict[bytes, list] = {}
         for traces, bytes_read, pruned, coalesced in results:
             if stats is not None:
@@ -387,8 +469,9 @@ class TempoDB:
                 return blk.collect_spans_for_ids(hex_ids)
 
             full, errors = self.pool.run_jobs([lambda m=m: complete(m) for m in metas])
-            if errors:
-                raise errors[0]
+            fatal = _fatal(errors)
+            if fatal:
+                raise fatal[0]
             by_id = {}
             for traces in full:
                 for t in traces:
@@ -455,9 +538,13 @@ class TempoDB:
                             local[tid] = p
                 return local, blk.bytes_read, n_traces, seen_tids
 
-            results, errors = self.pool.run_jobs([lambda m=m: job(m) for m in metas])
+            results, errors = self.pool.run_jobs(
+                [lambda m=m: self.guard_block(tenant, m.block_id, lambda: job(m),
+                                              benign=(vector.Unsupported,))
+                 for m in metas]
+            )
             straddled = False
-            if structural and not errors:
+            if structural and not _fatal(errors):
                 counts: dict = {}
                 for _local, _b, _n, seen in results:
                     for tid in seen:
@@ -468,8 +555,8 @@ class TempoDB:
                 # or a trace straddling blocks under a structural query):
                 # the object engine below answers exactly
                 pass
-            elif errors:
-                raise errors[0]
+            elif _fatal(errors):
+                raise _fatal(errors)[0]
             else:
                 partials: dict = {}
                 for local, bytes_read, n_traces, _seen in results:
@@ -496,6 +583,64 @@ class TempoDB:
         metas, compacted = self.poller.do()
         self.blocklist.apply_poll_results(metas, compacted)
         self.last_poll = time.time()
+
+    def sweep_orphans(self, grace_s: float | None = None, now: float | None = None) -> list[tuple[str, str]]:
+        """Delete meta-less partial blocks — the debris of a crash
+        between data/index/bloom writes and the meta.json commit (the
+        meta-LAST protocol makes such blocks invisible; this reclaims
+        their bytes). A block must be seen meta-less on an earlier sweep
+        at least grace_s ago before it is deleted, so a healthy writer
+        mid-block is never raced. Returns the (tenant, block_id) pairs
+        removed. Run by the compactor's retention cycle (one owner — the
+        same instance that may clear compacted blocks), or explicitly at
+        startup."""
+        from tempo_tpu.backend.base import NotFound as _NF
+
+        grace = self.cfg.orphan_grace_s if grace_s is None else grace_s
+        now = now or time.time()
+        removed: list[tuple[str, str]] = []
+
+        def is_orphan(tenant, block_id):
+            """True only when BOTH metas are definitively absent; a
+            transient read error is not evidence of anything."""
+            for read in (self.backend.block_meta, self.backend.compacted_block_meta):
+                try:
+                    read(tenant, block_id)
+                    return False
+                except _NF:
+                    continue
+                except Exception:
+                    return None  # unknown: skip this cycle
+            return True
+
+        for tenant in self.backend.tenants():
+            for block_id in self.backend.blocks(tenant):
+                key = (tenant, block_id)
+                orphan = is_orphan(tenant, block_id)
+                if orphan is None:
+                    continue
+                if not orphan:
+                    with self._orphan_lock:
+                        self._orphan_seen.pop(key, None)
+                    continue
+                with self._orphan_lock:
+                    first = self._orphan_seen.setdefault(key, now)
+                if now - first < grace:
+                    continue
+                log.warning(
+                    "orphan sweep: deleting meta-less partial block %s/%s "
+                    "(meta-less for %.0fs)", tenant, block_id, now - first,
+                )
+                try:
+                    self.backend.clear_block(tenant, block_id)
+                except Exception:
+                    log.exception("orphan sweep: clearing %s/%s failed", tenant, block_id)
+                    continue
+                with self._orphan_lock:
+                    self._orphan_seen.pop(key, None)
+                orphans_swept.inc(tenant=tenant)
+                removed.append(key)
+        return removed
 
     def compact_once(self, tenant: str | None = None, max_jobs: int = 0) -> int:
         if tenant is not None:
@@ -531,6 +676,14 @@ class TempoDB:
             # drains write-behind queues and closes memcached sockets
             self._cache_client.stop()
             self._cache_client = None
+
+
+def _fatal(errors) -> list:
+    """Drop the benign deleted-mid-query race (NotFound) from a job-pool
+    error list; everything left must be surfaced, never swallowed."""
+    from tempo_tpu.backend.base import NotFound
+
+    return [e for e in errors if not isinstance(e, NotFound)]
 
 
 def _overlaps(meta, start: int, end: int) -> bool:

@@ -33,15 +33,37 @@ log = logging.getLogger(__name__)
 blocklist_length = metrics.gauge(
     "tempodb_blocklist_length", "Current blocklist length per tenant"
 )
+quarantined_blocks = metrics.gauge(
+    "tempodb_blocklist_quarantined_blocks",
+    "Blocks quarantined after repeated read/checksum failures, per tenant "
+    "(see runbook: TempoTpuBlockQuarantined)",
+)
+quarantined_skips = metrics.counter(
+    "tempodb_quarantined_block_skips_total",
+    "Times a quarantined block was skipped by a query or the compactor",
+)
 
 
 class Blocklist:
-    """Thread-safe per-tenant lists of live + compacted block metas."""
+    """Thread-safe per-tenant lists of live + compacted block metas.
 
-    def __init__(self):
+    Also owns the QUARANTINE: blocks that repeatedly fail reads (page
+    checksum failures count double — they are definitively the block's
+    fault) are pulled out of the default metas() view, so queries and
+    the compaction selector skip them
+    instead of failing every request that touches them. Quarantine is
+    in-memory per instance (like the blocklist itself) and survives
+    polls; an operator clears it with unquarantine() after repairing or
+    deleting the block (runbook: TempoTpuBlockQuarantined).
+    """
+
+    def __init__(self, quarantine_threshold: int = 3):
         self._lock = threading.Lock()
         self._metas: dict[str, list[BlockMeta]] = {}
         self._compacted: dict[str, list[CompactedBlockMeta]] = {}
+        self.quarantine_threshold = quarantine_threshold
+        self._failures: dict[tuple[str, str], int] = {}
+        self._quarantined: dict[str, dict[str, str]] = {}  # tenant -> id -> reason
 
     def tenants(self) -> list[str]:
         with self._lock:
@@ -51,9 +73,64 @@ class Blocklist:
         with self._lock:
             return [t for t, c in self._compacted.items() if c]
 
-    def metas(self, tenant: str) -> list[BlockMeta]:
+    def metas(self, tenant: str, include_quarantined: bool = False) -> list[BlockMeta]:
         with self._lock:
-            return list(self._metas.get(tenant, []))
+            out = list(self._metas.get(tenant, []))
+            bad = self._quarantined.get(tenant)
+        if bad and not include_quarantined:
+            skipped = [m for m in out if m.block_id in bad]
+            if skipped:
+                quarantined_skips.inc(len(skipped), tenant=tenant)
+                out = [m for m in out if m.block_id not in bad]
+        return out
+
+    # -- quarantine ----------------------------------------------------
+    def record_block_failure(self, tenant: str, block_id: str, reason: str = "",
+                             weight: int = 1) -> bool:
+        """Count one failed read against a block; quarantine it at the
+        threshold. weight>1 fast-tracks definitive evidence (a checksum
+        mismatch is the block's fault; a connection reset may not be).
+        Returns True when this call newly quarantined the block."""
+        with self._lock:
+            if block_id in self._quarantined.get(tenant, ()):
+                return False
+            key = (tenant, block_id)
+            n = self._failures.get(key, 0) + weight
+            self._failures[key] = n
+            if n < self.quarantine_threshold:
+                return False
+            self._quarantined.setdefault(tenant, {})[block_id] = reason
+            self._failures.pop(key, None)
+            quarantined_blocks.set(len(self._quarantined[tenant]), tenant=tenant)
+        log.error(
+            "QUARANTINING block %s/%s after repeated failures (%s) — queries and "
+            "compaction will skip it; see runbook TempoTpuBlockQuarantined",
+            tenant, block_id, reason,
+        )
+        return True
+
+    def record_block_success(self, tenant: str, block_id: str) -> None:
+        """A successful read resets the failure count: quarantine is for
+        persistent faults, not one unlucky streak per week."""
+        with self._lock:
+            self._failures.pop((tenant, block_id), None)
+
+    def quarantined(self, tenant: str) -> dict[str, str]:
+        with self._lock:
+            return dict(self._quarantined.get(tenant, {}))
+
+    def is_quarantined(self, tenant: str, block_id: str) -> bool:
+        with self._lock:
+            return block_id in self._quarantined.get(tenant, ())
+
+    def unquarantine(self, tenant: str, block_id: str) -> bool:
+        """Operator escape hatch after repairing/deleting the block."""
+        with self._lock:
+            bad = self._quarantined.get(tenant, {})
+            hit = bad.pop(block_id, None)
+            self._failures.pop((tenant, block_id), None)
+            quarantined_blocks.set(len(bad), tenant=tenant)
+        return hit is not None
 
     def compacted_metas(self, tenant: str) -> list[CompactedBlockMeta]:
         with self._lock:

@@ -166,13 +166,41 @@ class CompactionDriver:
             try:
                 self.compact_blocks(tenant, group)
                 jobs += 1
-            except Exception:
+            except Exception as e:
                 self.metrics.errors += 1
                 compaction_errors.inc(tenant=tenant)
                 log.exception("compaction job %s failed", job_hash)
+                # a checksum failure is an input block's fault: count it
+                # toward quarantine so the selector stops re-picking the
+                # same poisoned group every cycle (the selector reads
+                # blocklist.metas, which excludes quarantined blocks)
+                from tempo_tpu.encoding.vtpu.codec import CorruptPage
+
+                if isinstance(e, CorruptPage):
+                    self._attribute_corruption(tenant, group, e)
             if max_jobs and jobs >= max_jobs:
                 break
         return jobs
+
+    def _attribute_corruption(self, tenant: str, group: list, err) -> None:
+        """The merge can't tell whose page failed its checksum, and
+        blaming the whole group would quarantine innocent inputs — so
+        scrub each input individually (decode every page, cache
+        bypassed) and count the failure only against blocks that are
+        actually corrupt. Checksum evidence is definitive: weight 2
+        fast-tracks quarantine."""
+        for m in group:
+            try:
+                blk = self.db.encoding_for(m.version).open_block(
+                    m, self.db.backend, self.db.cfg.block
+                )
+                blk.scrub()
+            except Exception as probe_err:  # noqa: BLE001 — probe is best-effort
+                self.db.blocklist.record_block_failure(
+                    tenant, m.block_id, f"compaction: {probe_err}", weight=2
+                )
+                log.error("compaction input %s/%s fails integrity scrub: %s",
+                          tenant, m.block_id, probe_err)
 
     def compact_blocks(self, tenant: str, group: list[BlockMeta]):
         enc = self.db.encoding_for(group[0].version)
@@ -198,6 +226,14 @@ class CompactionDriver:
         finally:
             if warn is not None:
                 warn.cancel()
+        # COMMIT ORDER (crash safety): compact() returns only after the
+        # output block's meta.json is durable (BlockWriter.finish writes
+        # meta LAST), so inputs are marked compacted strictly after the
+        # output is visible. A crash before this line leaves inputs live
+        # and at worst a meta-less partial output for the orphan sweep; a
+        # crash mid-loop leaves some inputs live alongside the output —
+        # duplicate data that queries dedupe by trace/span identity and
+        # the next compaction cycle collapses.
         now = time.time()
         compacted = []
         for m in group:

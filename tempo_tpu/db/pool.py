@@ -8,6 +8,7 @@ queries. Python threads are fine here: block queries are IO-bound
 
 from __future__ import annotations
 
+import contextvars
 import threading
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 
@@ -27,12 +28,16 @@ class JobPool:
         if not jobs:
             return results, errors
         stop = threading.Event()
+        # propagate the caller's context (the request's deadline scope,
+        # util/deadline.py) into worker threads: a block read running on
+        # behalf of a deadlined query must see that deadline
+        ctx = contextvars.copy_context()
 
         def wrap(fn):
             def run():
                 if stop.is_set():
                     return None
-                return fn()
+                return ctx.copy().run(fn)
 
             return run
 

@@ -35,9 +35,24 @@ class RetentionDriver:
             if retention > 0:
                 self._mark_expired(tenant, now, retention)
             self._clear_compacted(tenant, now, cfg.compacted_retention_s)
+        # crash debris: blocks whose writer died between data/index/bloom
+        # and the meta.json commit are invisible to queries (meta-LAST
+        # protocol) but still hold bytes — sweep them here, on the same
+        # single owner that clears compacted blocks
+        try:
+            self.db.sweep_orphans(now=now)
+        except Exception:
+            log.exception("orphan sweep failed")
 
     def _mark_expired(self, tenant, now, retention):
-        expired = [m for m in self.db.blocklist.metas(tenant) if m.end_time < now - retention]
+        # include quarantined blocks: quarantine hides a block from
+        # queries and compaction, but retention must still expire it —
+        # otherwise a corrupt block's bytes outlive the tenant's
+        # retention window forever
+        expired = [
+            m for m in self.db.blocklist.metas(tenant, include_quarantined=True)
+            if m.end_time < now - retention
+        ]
         compacted = []
         for m in expired:
             try:

@@ -143,6 +143,14 @@ class SearchResponse:
     # inspectedBytes
     pruned_row_groups: int = 0
     coalesced_reads: int = 0
+    # graceful degradation: "complete" | "partial". The frontend marks a
+    # response partial when terminal shard failures stayed within the
+    # tenant's failed-shard budget (failed_shards counts them); a partial
+    # response may be missing matching traces from the failed shards and
+    # clients must surface that (reference analog: the search SLO mixin's
+    # partial-result accounting)
+    status: str = "complete"
+    failed_shards: int = 0
 
     def merge(self, other: "SearchResponse", limit: int = 0) -> None:
         seen = {t.trace_id_hex for t in self.traces}
@@ -158,9 +166,12 @@ class SearchResponse:
         self.inspected_blocks += other.inspected_blocks
         self.pruned_row_groups += other.pruned_row_groups
         self.coalesced_reads += other.coalesced_reads
+        if other.status == "partial":
+            self.status = "partial"
+        self.failed_shards += other.failed_shards
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "traces": [t.to_dict() for t in self.traces],
             "metrics": {
                 "inspectedTraces": self.inspected_traces,
@@ -170,6 +181,12 @@ class SearchResponse:
                 "coalescedReads": self.coalesced_reads,
             },
         }
+        if self.status != "complete":
+            # added only when degraded so complete responses stay
+            # byte-identical to the pre-partial wire form
+            d["status"] = self.status
+            d["metrics"]["failedShards"] = self.failed_shards
+        return d
 
     @staticmethod
     def from_dict(doc: dict) -> "SearchResponse":
@@ -190,4 +207,6 @@ class SearchResponse:
         resp.inspected_blocks = m.get("inspectedBlocks", 0)
         resp.pruned_row_groups = m.get("prunedRowGroups", 0)
         resp.coalesced_reads = m.get("coalescedReads", 0)
+        resp.status = doc.get("status", "complete")
+        resp.failed_shards = m.get("failedShards", 0)
         return resp

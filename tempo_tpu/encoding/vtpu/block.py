@@ -156,6 +156,19 @@ class VtpuBackendBlock:
             self._index = fmt.BlockIndex.from_bytes(raw)
         return self._index
 
+    def scrub(self) -> int:
+        """Integrity pass: fetch and decode EVERY page, bypassing the
+        decoded-page cache, so any stored corruption raises CorruptPage.
+        Returns the number of pages verified. Used to attribute a
+        compaction-time checksum failure to the guilty input block (the
+        merge can't know whose page it was) and as an operator check
+        before unquarantining."""
+        n = 0
+        for rg in self.index().row_groups:
+            cols = self._fetch_columns(rg, list(rg.pages))
+            n += len(cols)
+        return n
+
     def iter_trace_batches(self):
         """All span rows, one SpanBatch per row group, trace-sorted —
         the streaming read the block-convert tooling uses (reference:

@@ -135,11 +135,22 @@ def decode(page: bytes, dtype: str, shape: tuple, codec: str, crc: int | None = 
     if codec == "none":
         raw = page
     elif codec == "zlib":
-        raw = zlib.decompress(page)
+        try:
+            raw = zlib.decompress(page)
+        except zlib.error as e:  # truncated (short read) or mangled stream
+            raise CorruptPage(f"zlib decode failed ({len(page)} bytes): {e}") from e
     elif codec in ("zstd", "zstd_shuffle"):
         raise ValueError(f"{codec} codec requires the native library (g++ + libzstd)")
     else:
         raise ValueError(f"unknown codec {codec!r}")
+    if len(raw) != raw_len:
+        # a short read of an uncompressed page, or a truncated stream
+        # that still decompressed — either way the page is not the data
+        # that was written
+        raise CorruptPage(
+            f"page payload is {len(raw)} bytes, expected {raw_len} "
+            f"(dtype={dtype}, shape={shape}, codec={codec})"
+        )
     actual_crc = zlib.crc32(raw)
     if crc is not None and actual_crc != crc:
         raise CorruptPage(f"crc mismatch for page ({len(page)} bytes, codec={codec})")

@@ -22,8 +22,15 @@ from dataclasses import dataclass
 from tempo_tpu.encoding.common import SearchRequest, SearchResponse, TraceSearchMetadata
 from tempo_tpu.model.trace import combine_traces
 from tempo_tpu.modules.worker import JobBroker, decode_trace_result
+from tempo_tpu.util import metrics
 
 log = logging.getLogger(__name__)
+
+partial_results_total = metrics.counter(
+    "tempo_query_frontend_partial_results_total",
+    "Queries answered with status=partial (terminal shard failures "
+    "within the tenant's failed-shard budget)",
+)
 
 
 def create_block_boundaries(n_shards: int) -> list[str]:
@@ -51,6 +58,12 @@ class FrontendConfig:
     # HedgeRequestsAt ~2s); 0 disables. Duplicated partials are safe —
     # every merge path dedupes by trace/span identity.
     hedge_after_s: float = 2.0
+    # graceful degradation default: fraction of a query's shards allowed
+    # to fail terminally before the whole query fails; within budget,
+    # search/query_range return status="partial" + failed-shard counts.
+    # 0 preserves strict all-or-nothing semantics. Per-tenant override:
+    # overrides.Limits.query_partial_shard_fraction (>= 0 wins).
+    max_failed_shard_fraction: float = 0.0
 
 
 class Frontend:
@@ -65,22 +78,36 @@ class Frontend:
         self.overrides = overrides
 
     # ------------------------------------------------------------------
-    # error-type prefixes that must not burn retries (reference retry.go
-    # retries 5xx only; worker errors travel as "Type: message" strings)
+    # error-type prefixes that are ALWAYS query-fatal (a malformed query
+    # fails every shard identically — partial results would just hide it)
     _CLIENT_ERRORS = ("ParseError", "ValueError", "PermissionError", "BadRequest")
+    # prefixes that must not burn retries (reference retry.go retries 5xx
+    # only; worker errors travel as "Type: message" strings): client
+    # errors, exceeded deadlines (the requester already gave up —
+    # re-running only amplifies load), and checksum failures (the same
+    # block returns the same corrupt bytes; quarantine, not retry)
+    _NO_RETRY = _CLIENT_ERRORS + ("DeadlineExceeded", "CorruptPage")
 
     def _run_jobs(self, tenant: str, descs: list[dict]) -> tuple[list, list]:
         """Submit all descriptors; resubmit failures up to max_retries.
         A timed-out job that later completes AND gets retried can yield
         a duplicate partial; all merge paths dedupe by trace/span
-        identity."""
+        identity.
+
+        Deadline propagation: every descriptor is stamped with one
+        absolute deadline (now + job_timeout_s). Workers enter a deadline
+        scope around execution so backend timeouts shrink to the
+        remaining budget, and the frontend never resubmits past it — an
+        exceeded deadline is terminal, not retried."""
         from tempo_tpu.modules.worker import JobError
 
+        deadline_ts = time.time() + self.cfg.job_timeout_s
+        descs = [{**d, "deadline": deadline_ts} for d in descs]
         groups = [[self.broker.submit(tenant, d)] for d in descs]
         results: list = []
-        terminal_errors: list = []  # client errors: never retried, never lost
+        terminal_errors: list = []  # never retried, never lost
         for attempt in range(self.cfg.max_retries + 1):
-            self._wait_groups(tenant, groups, timeout_s=self.cfg.job_timeout_s)
+            self._wait_groups(tenant, groups, timeout_s=deadline_ts - time.time())
             # classify each group exactly once — a job finishing between
             # two passes must land in exactly one bucket
             failed = []
@@ -89,16 +116,17 @@ class Frontend:
                 if done_ok is not None:
                     results.append(done_ok.result)
                     continue
-                client_err = next(
+                noretry = next(
                     (p for p in grp
-                     if p.error is not None and p.error.startswith(self._CLIENT_ERRORS)),
+                     if p.error is not None and p.error.startswith(self._NO_RETRY)),
                     None,
                 )
-                if client_err is not None:
-                    terminal_errors.append(JobError(client_err.error))  # not retryable
+                if noretry is not None:
+                    terminal_errors.append(JobError(noretry.error))
                 else:
                     failed.append(grp)
-            if not failed or attempt == self.cfg.max_retries:
+            out_of_time = time.time() >= deadline_ts
+            if not failed or attempt == self.cfg.max_retries or out_of_time:
                 for grp in failed:
                     p = grp[0]
                     terminal_errors.append(
@@ -112,6 +140,36 @@ class Frontend:
             )
             groups = [[self.broker.submit(tenant, grp[0].desc)] for grp in failed]
         return results, terminal_errors
+
+    def _settle(self, tenant: str, n_shards: int, results: list, errors: list) -> int:
+        """Apply the failed-shard budget to a query's terminal errors.
+
+        Returns the failed-shard count the caller must surface as
+        status="partial" (0 = complete). Raises when any error is a
+        client error (every shard would fail the same way), when
+        failures exceed the tenant's budget, or when NO shard produced a
+        result (an all-failed "partial" is an outage, not degradation).
+        """
+        if not errors:
+            return 0
+        for e in errors:
+            if str(e).startswith(self._CLIENT_ERRORS):
+                raise e
+        frac = self.cfg.max_failed_shard_fraction
+        if self.overrides is not None:
+            t_frac = self.overrides.for_tenant(tenant).query_partial_shard_fraction
+            if t_frac >= 0:
+                frac = t_frac
+        allowed = int(frac * n_shards)
+        if len(errors) > allowed or not results:
+            raise errors[0]
+        partial_results_total.inc(tenant=tenant)
+        log.warning(
+            "serving PARTIAL results for tenant %s: %d/%d shards failed "
+            "terminally (budget %d): %s",
+            tenant, len(errors), n_shards, allowed, errors[0],
+        )
+        return len(errors)
 
     def _wait_groups(self, tenant: str, groups: list, timeout_s: float) -> None:
         """Wait until every group has a finished member or the timeout
@@ -208,12 +266,17 @@ class Frontend:
             descs.append({"kind": "search_blocks", "block_ids": group, "search": req.to_dict()})
 
         results, errors = self._run_jobs(tenant, descs)
-        if errors:
-            raise errors[0]
+        failed = self._settle(tenant, len(descs), results, errors)
         out = SearchResponse()
         for r in results:
             if "response" in r:
                 out.merge(SearchResponse.from_dict(r["response"]), limit=req.limit)
+        if failed:
+            # degradation contract: whenever status is NOT "partial" the
+            # results are bit-identical to a fault-free run; when it is,
+            # failed_shards says exactly how many shards are missing
+            out.status = "partial"
+            out.failed_shards += failed
         return out
 
     # ------------------------------------------------------------------
@@ -276,10 +339,10 @@ class Frontend:
                               "start": w0, "end": w1, **common})
 
         results, errors = self._run_jobs(tenant, descs)
-        if errors:
-            # a failed shard is a hole in the range vector; fail the
-            # query rather than return silently wrong rates
-            raise errors[0]
+        # a failed shard is a hole in the range vector: NEVER silently
+        # wrong rates — either fail the query (over budget) or flag the
+        # response partial with an exact failed-shard count
+        failed = self._settle(tenant, len(descs), results, errors)
         merged = new_wire()
         for r in results:
             off = (int(r.get("start", plan.start_s)) - plan.start_s) // plan.step_s
@@ -292,7 +355,12 @@ class Frontend:
                 f"query exceeds max_series={max_series} on at least one "
                 "shard; narrow the filter or raise max_series"
             )
-        return finalize_matrix(plan, merged)
+        mat = finalize_matrix(plan, merged)
+        if failed:
+            mat["status"] = "partial"
+            mat["failedShards"] = failed
+            mat.setdefault("stats", {})["failedShards"] = failed
+        return mat
 
     # ------------------------------------------------------------------
     def traceql(self, tenant: str, query: str, start_s=0, end_s=0, limit=20,

@@ -34,6 +34,13 @@ class Limits:
     max_bytes_per_tag_values_query: int = 5 * 1024 * 1024
     max_search_duration_s: int = 0  # 0 = unlimited
     max_queriers_per_tenant: int = 0  # query shuffle-sharding
+    # graceful degradation: fraction of a query's shards allowed to fail
+    # terminally before the whole query fails — within budget the
+    # frontend returns status="partial" with a failed-shard count.
+    # -1 = inherit the frontend default (FrontendConfig.
+    # max_failed_shard_fraction); 0 = any terminal shard failure fails
+    # the query (strict completeness)
+    query_partial_shard_fraction: float = -1.0
     # storage
     block_retention_s: int = 0  # 0 = fall back to engine default
     # generator

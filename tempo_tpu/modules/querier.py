@@ -12,6 +12,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from tempo_tpu.backend.base import NotFound
 from tempo_tpu.encoding.common import SearchRequest, SearchResponse, TraceSearchMetadata
 from tempo_tpu.encoding.vtpu import format as fmt
 from tempo_tpu.model.columnar import SpanBatch
@@ -108,18 +109,25 @@ class Querier:
         scan serially like the reference's per-job loop."""
         searcher = self.db.mesh_searcher() if not self.external_endpoints else None
         if searcher is not None and len(block_ids) > 1:
+            # only a definitive NotFound (deleted by compaction between
+            # shard planning and execution) skips a block; a transient
+            # meta-read error raises so the worker retries the job
             metas = []
             for bid in block_ids:
                 try:
                     metas.append(self.db.backend.block_meta(tenant, bid))
-                except Exception:
-                    log.warning("search job: block %s meta unreadable (deleted?)", bid)
+                except NotFound:
+                    log.warning("search job: block %s deleted mid-query", bid)
             if metas and all(m.version == "vtpu1" for m in metas):
                 blocks = (
                     self.db.encoding_for(m.version).open_block(m, self.db.backend, self.db.cfg.block)
                     for m in metas
                 )  # lazy: early-exit skips opening later blocks
-                return searcher.search_blocks(blocks, req)
+                return searcher.search_blocks(
+                    blocks, req,
+                    on_block_error=self.db.block_failure_recorder(tenant),
+                    on_block_ok=self.db.block_success_recorder(tenant),
+                )
         resp = SearchResponse()
         for block_id in block_ids:
             resp.merge(self.search_block_job(tenant, block_id, req), limit=req.limit)
@@ -196,8 +204,8 @@ class Querier:
         for bid in block_ids:
             try:
                 metas.append(self.db.backend.block_meta(tenant, bid))
-            except Exception:
-                log.warning("metrics job: block %s meta unreadable (deleted?)", bid)
+            except NotFound:  # deleted mid-query: benign; other errors
+                log.warning("metrics job: block %s deleted mid-query", bid)
         evaluator = self.db.mesh_metrics_evaluator()
         if evaluator is not None and len(metas) > 1 and all(
             m.version == "vtpu1" for m in metas
@@ -207,14 +215,38 @@ class Querier:
                 self.db.encoding_for(m.version).open_block(m, self.db.backend, self.db.cfg.block)
                 for m in metas
             )  # lazy: pruning decisions happen per block as the scan reaches it
-            evaluator.evaluate_blocks(blocks, plan, acc)
+            evaluator.evaluate_blocks(
+                blocks, plan, acc,
+                on_block_error=self.db.block_failure_recorder(tenant),
+                on_block_ok=self.db.block_success_recorder(tenant),
+            )
             return acc.to_wire()
         acc = make_accumulator(plan)
         for m in metas:
-            blk = self.db.encoding_for(m.version).open_block(m, self.db.backend, self.db.cfg.block)
-            acc.stats["inspectedBlocks"] += 1
-            evaluate_block(plan, blk, acc)
-            acc.stats["inspectedBytes"] += blk.bytes_read
+            # per-block sub-accumulator (shared series table), merged
+            # only on success: counts have no dedupe, so a block deleted
+            # mid-evaluation must contribute nothing — its spans live on
+            # in the compaction output that replaced it
+            sub = type(acc)(plan, series=acc.series)
+
+            def run(meta=m, sub=sub):
+                blk = self.db.encoding_for(meta.version).open_block(
+                    meta, self.db.backend, self.db.cfg.block)
+                sub.stats["inspectedBlocks"] += 1
+                evaluate_block(plan, blk, sub)
+                sub.stats["inspectedBytes"] += blk.bytes_read
+
+            try:
+                self.db.guard_block(tenant, m.block_id, run)
+            except NotFound:
+                log.warning("metrics job: block %s deleted mid-query", m.block_id)
+                continue
+            acc.counts += sub.merged_counts()
+            for k, v in sub.stats.items():
+                acc.stats[k] = acc.stats.get(k, 0) + v
+            for key, ex in sub.exemplars.items():
+                have = acc.exemplars.setdefault(key, [])
+                have.extend(ex[: max(0, plan.exemplars - len(have))])
         return acc.to_wire()
 
     def search_tags(self, tenant: str) -> list[str]:

@@ -32,12 +32,22 @@ import time
 
 from tempo_tpu.encoding.common import SearchRequest, SearchResponse
 from tempo_tpu.modules.queue import RequestQueue
+from tempo_tpu.util import deadline
 
 log = logging.getLogger(__name__)
 
 
 # -- executing a descriptor on a querier ---------------------------------
 def execute_job(querier, tenant: str, desc: dict) -> dict:
+    """Run one descriptor inside its deadline scope: the frontend stamps
+    every desc with an absolute `deadline` (util/deadline.py), so every
+    backend read below bounds its timeouts by the remaining budget and a
+    job whose requester already gave up stops consuming work."""
+    with deadline.scope(desc.get("deadline")):
+        return _execute_job(querier, tenant, desc)
+
+
+def _execute_job(querier, tenant: str, desc: dict) -> dict:
     kind = desc.get("kind")
     if kind == "find":
         trace = querier.find_trace_by_id(
@@ -168,13 +178,21 @@ class JobBroker:
 
 
 class LocalWorkerPool:
-    """In-process pull workers (single-binary mode)."""
+    """In-process pull workers (single-binary mode).
+
+    max_retries: transient failures (backend.faults.retryable_error —
+    connection-ish errors) are retried in place with a short backoff
+    before the error travels back to the frontend; terminal errors
+    (NotFound, CorruptPage, DeadlineExceeded, client mistakes) fail
+    immediately — repeating them cannot succeed and only adds load.
+    """
 
     def __init__(self, broker: JobBroker, querier, n_workers: int = 4,
-                 max_retries: int = 2):
+                 max_retries: int = 2, retry_backoff_s: float = 0.05):
         self.broker = broker
         self.querier = querier
         self.max_retries = max_retries
+        self.retry_backoff_s = retry_backoff_s
         self._stop = threading.Event()
         self.threads = [
             threading.Thread(target=self._run, daemon=True, name=f"query-worker-{i}")
@@ -182,6 +200,26 @@ class LocalWorkerPool:
         ]
         for t in self.threads:
             t.start()
+
+    def _execute(self, tenant: str, desc: dict) -> dict:
+        from tempo_tpu.backend.faults import retryable_error
+
+        # scope entered here too (execute_job re-enters, harmlessly) so
+        # the retry backoff is bounded by the job's remaining deadline
+        # and a between-attempts expiry is caught before wasted work
+        with deadline.scope(desc.get("deadline")):
+            last: Exception | None = None
+            for attempt in range(self.max_retries + 1):
+                try:
+                    return execute_job(self.querier, tenant, desc)
+                except Exception as e:  # noqa: BLE001 — classified below
+                    if not retryable_error(e) or attempt == self.max_retries:
+                        raise
+                    last = e
+                    self._stop.wait(deadline.bound_timeout(
+                        min(self.retry_backoff_s * (2 ** attempt), 1.0)))
+                    deadline.check()
+            raise last  # pragma: no cover — loop always returns or raises
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -192,7 +230,7 @@ class LocalWorkerPool:
                 continue
             job_id, tenant, desc = item
             try:
-                self.broker.complete(job_id, result=execute_job(self.querier, tenant, desc))
+                self.broker.complete(job_id, result=self._execute(tenant, desc))
             except Exception as e:  # noqa: BLE001 — error travels to the waiter
                 self.broker.complete(job_id, error=f"{type(e).__name__}: {e}")
 
@@ -208,11 +246,13 @@ class RemoteWorker:
     the local querier, posts results (reference: modules/querier/worker
     DNS-discovers frontends and opens Process streams)."""
 
-    def __init__(self, frontend_url: str, querier, n_threads: int = 2):
+    def __init__(self, frontend_url: str, querier, n_threads: int = 2,
+                 result_post_retries: int = 2):
         from tempo_tpu.backend.httpclient import PooledHTTPClient
 
         self.client = PooledHTTPClient(frontend_url, timeout_s=30.0, max_retries=0)
         self.querier = querier
+        self.result_post_retries = result_post_retries
         self._stop = threading.Event()
         self.threads = [
             threading.Thread(target=self._run, daemon=True, name=f"remote-worker-{i}")
@@ -240,17 +280,34 @@ class RemoteWorker:
                     out = {"result": execute_job(self.querier, tenant, desc)}
                 except Exception as e:  # noqa: BLE001
                     out = {"error": f"{type(e).__name__}: {e}"}
-                self.client.request(
-                    "POST",
-                    f"/rpc/v1/worker/result/{job_id}",
-                    headers={"Content-Type": "application/json"},
-                    body=json.dumps(out).encode(),
-                    ok=(200, 404),  # 404: lease expired, someone else ran it
-                )
+                self._post_result(job_id, json.dumps(out).encode())
             except Exception as e:  # frontend down: back off and retry
                 if not self._stop.is_set():
                     log.debug("worker poll failed: %s", e)
                     self._stop.wait(0.5)
+
+    def _post_result(self, job_id: str, body: bytes) -> None:
+        """POST a computed result with a few retries: one connection blip
+        here would otherwise throw away a finished job — the lease would
+        expire and the whole job be recomputed elsewhere, which is the
+        expensive path, not the cheap one."""
+        last: Exception | None = None
+        for attempt in range(self.result_post_retries + 1):
+            try:
+                self.client.request(
+                    "POST",
+                    f"/rpc/v1/worker/result/{job_id}",
+                    headers={"Content-Type": "application/json"},
+                    body=body,
+                    ok=(200, 404),  # 404: lease expired, someone else ran it
+                )
+                return
+            except Exception as e:  # noqa: BLE001 — transport-level only
+                last = e
+                if attempt < self.result_post_retries and not self._stop.is_set():
+                    self._stop.wait(min(0.1 * (2 ** attempt), 1.0))
+        log.warning("result POST for %s failed after %d attempts: %s",
+                    job_id, self.result_post_retries + 1, last)
 
     def stop(self) -> None:
         self._stop.set()

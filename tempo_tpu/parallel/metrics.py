@@ -77,11 +77,19 @@ class MeshMetricsEvaluator:
         self.bucket_for = bucket_for
         self.last_stats: dict = {}
 
-    def evaluate_blocks(self, blocks, plan, acc) -> None:
+    def evaluate_blocks(self, blocks, plan, acc, on_block_error=None,
+                        on_block_ok=None) -> None:
         """blocks: iterable of lazily-opened VtpuBackendBlocks. Row
         groups are zone-map/time pruned with zero reads, surviving units
         evaluate host-side to slot ids, and slot batches dispatch in
-        stacked (W, R) chunks under the process-wide mesh lock."""
+        stacked (W, R) chunks under the process-wide mesh lock.
+
+        Failure domains mirror MeshSearcher.search_blocks: a block
+        deleted mid-query (NotFound) is skipped, but any other read
+        error raises — a metrics job must fail loudly and let the
+        worker's retry taxonomy / frontend shard budget decide, never
+        return silently-reduced counts that look complete. The
+        on_block_error/on_block_ok callbacks feed quarantine accounting."""
         from tempo_tpu.encoding.vtpu.block import (
             pruned_row_groups_total,
             zone_maps_enabled,
@@ -118,45 +126,75 @@ class MeshMetricsEvaluator:
             stats["h2d_bytes"] += stacked.nbytes
             pending.clear()
 
+        from tempo_tpu.backend.base import NotFound
+
         for blk in blocks:
             opened.append(blk)
-            acc.stats["inspectedBlocks"] += 1
+            # buffer this block's contributions and commit them only once
+            # the WHOLE block has evaluated: counts are integer adds with
+            # no dedupe, so a block deleted mid-scan (NotFound below)
+            # must contribute nothing — its spans live on in the
+            # compaction output that replaced it, and a half-committed
+            # block would double-count them in a response that carries no
+            # partial flag
+            blk_batches: list[np.ndarray] = []
+            blk_results: list = []  # (res, view) for exemplars
+            blk_spans = 0
+            blk_pruned = 0
+            from tempo_tpu.backend.faults import with_retries
+
             try:
-                d = blk.dictionary()
+                d = with_retries(blk.dictionary)
                 resolvers, impossible = _lower_prunes(plan, d)
                 if impossible:
+                    acc.stats["inspectedBlocks"] += 1
+                    if on_block_ok is not None:
+                        on_block_ok(blk.meta.block_id)
                     continue
-                row_groups = list(blk.index().row_groups)
-            except Exception as e:  # deleted mid-query: skip, like search
-                log.warning("mesh metrics: block %s unreadable: %s",
-                            blk.meta.block_id, e)
-                continue
-            for rg in row_groups:
-                if rg.end_s < plan.start_s or rg.start_s > plan.end_s:
-                    continue
-                if zm and resolvers and rg_prunes(plan, rg, resolvers, all_conds):
-                    acc.stats["prunedRowGroups"] += 1
-                    blk.pruned_row_groups += 1
-                    pruned_row_groups_total.inc()
-                    continue
-                try:
-                    cols = blk.read_columns(rg, list(plan.span_cols))
+                for rg in with_retries(blk.index).row_groups:
+                    if rg.end_s < plan.start_s or rg.start_s > plan.end_s:
+                        continue
+                    if zm and resolvers and rg_prunes(plan, rg, resolvers, all_conds):
+                        blk_pruned += 1
+                        continue
+                    cols = with_retries(
+                        lambda b=blk, r=rg: b.read_columns(r, list(plan.span_cols)))
                     attrs = (
-                        blk.read_columns(rg, list(ATTR_COLUMNS))
+                        with_retries(
+                            lambda b=blk, r=rg: b.read_columns(r, list(ATTR_COLUMNS)))
                         if plan.needs_attrs
                         else _empty_cols(ATTR_COLUMNS)
                     )
-                except Exception as e:
-                    log.warning("mesh metrics: column load failed: %s", e)
-                    continue
-                view = vector.ColumnView(cols, attrs, rg.n_spans)
-                res = eval_batch(plan, view, d, acc.series)
-                acc.stats["inspectedSpans"] += rg.n_spans
+                    view = vector.ColumnView(cols, attrs, rg.n_spans)
+                    res = eval_batch(plan, view, d, acc.series)
+                    blk_spans += rg.n_spans
+                    blk_results.append((res, view))
+                    live = res.slots[res.slots >= 0].astype(np.int32)
+                    if len(live):
+                        blk_batches.append(live)
+            except NotFound as e:  # deleted mid-query: benign, skip whole block
+                log.warning("mesh metrics: block %s deleted mid-query: %s",
+                            blk.meta.block_id, e)
+                continue
+            except Exception as e:
+                log.warning("mesh metrics: block %s failed: %s",
+                            blk.meta.block_id, e)
+                if on_block_error is not None:
+                    on_block_error(blk.meta.block_id, e)
+                raise
+            acc.stats["inspectedBlocks"] += 1
+            acc.stats["inspectedSpans"] += blk_spans
+            if blk_pruned:
+                acc.stats["prunedRowGroups"] += blk_pruned
+                blk.pruned_row_groups += blk_pruned
+                pruned_row_groups_total.inc(blk_pruned)
+            for res, view in blk_results:
                 acc.observe_exemplars(res, view)
-                live = res.slots[res.slots >= 0].astype(np.int32)
-                if len(live):
-                    pending.append(live)
-                    if len(pending) >= cap:
-                        flush()
+            for live in blk_batches:
+                pending.append(live)
+                if len(pending) >= cap:
+                    flush()
+            if on_block_ok is not None:
+                on_block_ok(blk.meta.block_id)
         flush()
         acc.stats["inspectedBytes"] += sum(b.bytes_read for b in opened)

@@ -207,14 +207,23 @@ class MeshSearcher:
         return make_sharded_tag_scan_per_shard(self.mesh, n_cols, self.max_codes)
 
     # -- search ----------------------------------------------------------
-    def search_blocks(self, blocks, req) -> "object":
+    def search_blocks(self, blocks, req, on_block_error=None,
+                      on_block_ok=None) -> "object":
         """blocks: ITERABLE of lazily-opened VtpuBackendBlocks — a block
         is only opened (index + dictionary reads) when the scan actually
         reaches it, so limited queries over large tenants keep the old
         path's early-exit economy. Device path covers the span_eq
         predicates; duration/attr predicates AND in host-side on matched
         shards only. Results get the same dedupe / newest-first /
-        limit discipline as SearchResponse.merge."""
+        limit discipline as SearchResponse.merge.
+
+        Failure domains: every opened block reports one verdict through
+        on_block_error(block_id, exc) / on_block_ok(block_id) (the
+        caller feeds quarantine accounting), and any terminal error
+        fails the whole search loudly — the one result this path must
+        never produce is a silently truncated "complete" response.
+        NotFound is the benign deleted-mid-query race and only skips
+        the block."""
         import logging
 
         from tempo_tpu.encoding.common import SearchResponse
@@ -224,6 +233,8 @@ class MeshSearcher:
             zone_maps_enabled,
             zone_prunes,
         )
+
+        from tempo_tpu.backend.faults import with_retries
 
         log = logging.getLogger(__name__)
         zm = zone_maps_enabled()
@@ -282,12 +293,15 @@ class MeshSearcher:
                 for blk, i, rg, preds in chunk:
                     resp.inspected_traces += rg.n_traces
                     try:
-                        for h in blk._search_row_group(rg, req, preds, limit=0):
+                        rows = with_retries(
+                            lambda b=blk, r=rg, p=preds:
+                            list(b._search_row_group(r, req, p, limit=0)))
+                        for h in rows:
                             if h.trace_id_hex not in seen_ids:
                                 seen_ids.add(h.trace_id_hex)
                                 hits.append(h)
-                    except Exception as e:  # partial failure: skip the unit
-                        errors.append(e)
+                    except Exception as e:
+                        errors.append((blk, e))
                         log.warning("mesh search: row group scan failed: %s", e)
                     if req.limit and unique_hits() >= req.limit:
                         done = True
@@ -302,11 +316,12 @@ class MeshSearcher:
             for s, (blk, i, rg, preds) in enumerate(chunk):
                 try:
                     for c, (col_name, accept) in enumerate(preds["span_eq"]):
-                        cols[s, c, : rg.n_spans] = self._col(blk, i, rg, col_name)
+                        cols[s, c, : rg.n_spans] = with_retries(
+                            lambda b=blk, j=i, r=rg, n=col_name: self._col(b, j, r, n))
                         k = min(len(accept), self.max_codes)
                         codes[s, c, :k] = accept[:k]
                 except Exception as e:  # e.g. block deleted mid-query
-                    errors.append(e)
+                    errors.append((blk, e))
                     log.warning("mesh search: column load failed: %s", e)
                     continue
                 for c in range(len(preds["span_eq"]), n_cols):
@@ -334,9 +349,11 @@ class MeshSearcher:
                 if not span_mask.any():
                     continue
                 try:
-                    collect(blk, i, rg, preds, span_mask)
+                    # idempotent under retry: hit dedupe rides seen_ids
+                    with_retries(lambda b=blk, j=i, r=rg, p=preds, m=span_mask:
+                                 collect(b, j, r, p, m))
                 except Exception as e:
-                    errors.append(e)
+                    errors.append((blk, e))
                     log.warning("mesh search: hit collection failed: %s", e)
                 if done:
                     return
@@ -347,15 +364,15 @@ class MeshSearcher:
             opened.append(blk)
             resp.inspected_blocks += 1
             try:
-                preds = _resolve_tag_predicates(req, blk.dictionary())
+                preds = _resolve_tag_predicates(req, with_retries(blk.dictionary))
                 if preds is None:
                     continue  # impossible in this block: no more IO for it
-                row_groups = list(blk.index().row_groups)
+                row_groups = list(with_retries(blk.index).row_groups)
             except Exception as e:
                 # a block deleted between the blocklist snapshot and the
-                # read must not abort the whole tenant search (the
-                # per-block path tolerates exactly this)
-                errors.append(e)
+                # read (NotFound) must not abort the whole tenant search;
+                # anything else is surfaced below
+                errors.append((blk, e))
                 log.warning("mesh search: block %s unreadable: %s", blk.meta.block_id, e)
                 continue
             for i, rg in enumerate(row_groups):
@@ -378,10 +395,24 @@ class MeshSearcher:
         if not done:
             flush(pending)
 
-        if errors and not hits and resp.inspected_traces == 0:
-            # nothing succeeded at all: surface the failure (mirrors the
-            # pool path's "raise only when there are no results")
-            raise errors[0]
+        from tempo_tpu.backend.base import NotFound
+
+        failed: dict = {}
+        for bad_blk, e in errors:
+            failed.setdefault(bad_blk.meta.block_id, e)
+        for b in opened:
+            bid = b.meta.block_id
+            if bid in failed:
+                # NotFound is neither a strike nor a success: a block
+                # deleted by compaction mid-query is a benign race, not
+                # quarantine evidence (same exemption as guard_block)
+                if on_block_error is not None and not isinstance(failed[bid], NotFound):
+                    on_block_error(bid, failed[bid])
+            elif on_block_ok is not None:
+                on_block_ok(bid)
+        fatal = [e for _, e in errors if not isinstance(e, NotFound)]
+        if fatal:
+            raise fatal[0]
 
         # same result discipline as SearchResponse.merge: newest first,
         # truncated to the limit (dedupe already applied via seen_ids)

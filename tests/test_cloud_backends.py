@@ -398,3 +398,52 @@ class TestHTTPClient:
         assert time.monotonic() - t0 < 0.9  # did not wait for the straggler
         assert state["n"] >= 2
         srv.shutdown()
+
+    def test_hedged_fast_error_does_not_mask_slow_success(self):
+        """The hedge race is won by the first SUCCESSFUL response: a
+        transport that errors instantly must not beat a slower attempt
+        that is still in flight and about to succeed."""
+        state = {"n": 0}
+
+        class FailFastThenSlowOk(_BaseHandler):
+            def do_GET(self):  # noqa: N802
+                state["n"] += 1
+                if state["n"] == 1:
+                    # fast transport failure: drop the connection before
+                    # any status line is written
+                    self.connection.close()
+                    return
+                time.sleep(0.3)  # slow but healthy
+                self._reply(200, b"late-ok")
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), FailFastThenSlowOk)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        c = PooledHTTPClient(
+            f"http://127.0.0.1:{srv.server_address[1]}",
+            max_retries=0,  # isolate the hedge path from the retry loop
+            hedge=HedgeConfig(hedge_at_s=0.05),
+        )
+        status, body, _ = c.request("GET", "/x")
+        assert status == 200 and body == b"late-ok"
+        assert state["n"] >= 2
+        srv.shutdown()
+
+    def test_hedged_error_surfaces_only_when_all_attempts_fail(self):
+        state = {"n": 0}
+
+        class AlwaysDrop(_BaseHandler):
+            def do_GET(self):  # noqa: N802
+                state["n"] += 1
+                self.connection.close()
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), AlwaysDrop)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        c = PooledHTTPClient(
+            f"http://127.0.0.1:{srv.server_address[1]}",
+            max_retries=0,
+            hedge=HedgeConfig(hedge_at_s=0.01, hedge_up_to=2),
+        )
+        with pytest.raises(OSError):
+            c.request("GET", "/x")
+        assert state["n"] == 2  # every launched attempt got its chance
+        srv.shutdown()
