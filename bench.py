@@ -227,11 +227,15 @@ def _search_inputs(backend, cfg, n_blocks: int = 8, traces: int = 4096,
 
 
 def _search_rep(reps: int = 3) -> dict:
-    """Read-path economy rep: selective multi-block searches with zone
-    maps on vs off (TEMPO_TPU_ZONEMAPS=0), same blocks, cold column
-    cache per run. Publishes wall time, inspectedBytes (the bytes-
-    touched economy the read path is built around) and the pruning
-    counters; asserts each arm pair returns identical hit sets."""
+    """Read-path economy rep: selective multi-block searches across four
+    arms on identical data — `pruned` (zone maps + run-space, the
+    production path), `unpruned` (TEMPO_TPU_ZONEMAPS=0), `rowspace`
+    (TEMPO_TPU_RUNSPACE=0: every page expands, the pre-lightweight-tier
+    behavior — its decodedBytes is the HEAD baseline the zero-decode
+    path is measured against), and `legacy` (blocks WRITTEN without the
+    lightweight tier, exercising the old-format read path). Cold column
+    cache per run. Publishes wall time, inspectedBytes, decodedBytes and
+    the pruning counters; asserts ALL arms return identical hit sets."""
     from tempo_tpu.backend import LocalBackend, TypedBackend
     from tempo_tpu.encoding import from_version
     from tempo_tpu.encoding.common import BlockConfig, SearchRequest, SearchResponse
@@ -243,57 +247,79 @@ def _search_rep(reps: int = 3) -> dict:
         backend = TypedBackend(LocalBackend(tmp.name))
         cfg = BlockConfig(row_group_spans=2048)
         metas = _search_inputs(backend, cfg)
+        os.environ["TEMPO_TPU_LIGHTWEIGHT"] = "0"
+        try:
+            legacy_backend = TypedBackend(LocalBackend(os.path.join(tmp.name, "legacy")))
+            legacy_metas = _search_inputs(legacy_backend, cfg)
+        finally:
+            os.environ.pop("TEMPO_TPU_LIGHTWEIGHT", None)
         queries = {
             "tag": SearchRequest(tags={"service": "needle-svc"}, limit=0),
             "duration": SearchRequest(min_duration_ns=10**9, limit=0),
         }
+        ARMS = {
+            "pruned": ({}, metas, backend),
+            "unpruned": ({"TEMPO_TPU_ZONEMAPS": "0"}, metas, backend),
+            "rowspace": ({"TEMPO_TPU_RUNSPACE": "0"}, metas, backend),
+            "legacy": ({}, legacy_metas, legacy_backend),
+        }
 
-        def run_once(req) -> SearchResponse:
+        def run_once(req, ms, be) -> SearchResponse:
             cache = shared_cache()
             if cache is not None:
                 cache.clear()  # every run pays its own IO
             out = SearchResponse()
-            for m in metas:
-                out.merge(enc.open_block(m, backend, cfg).search(req))
+            for m in ms:
+                out.merge(enc.open_block(m, be, cfg).search(req))
             return out
 
         per_query: dict[str, dict] = {}
-        totals = {"pruned": {"s": 0.0, "bytes": 0}, "unpruned": {"s": 0.0, "bytes": 0}}
+        totals = {a: {"s": 0.0, "bytes": 0, "decoded": 0} for a in ARMS}
         parity_all = True
         for qname, req in queries.items():
             arms: dict[str, dict] = {}
             hitsets: dict[str, set] = {}
-            for arm, env in (("pruned", "1"), ("unpruned", "0")):
-                os.environ["TEMPO_TPU_ZONEMAPS"] = env
+            for arm, (env, ms, be) in ARMS.items():
+                for k, v in env.items():
+                    os.environ[k] = v
                 try:
-                    run_once(req)  # warm the page cache, not the column cache
+                    run_once(req, ms, be)  # warm the page cache, not the column cache
                     times = []
                     for _ in range(reps):
                         t0 = time.perf_counter()
-                        resp = run_once(req)
+                        resp = run_once(req, ms, be)
                         times.append(time.perf_counter() - t0)
                 finally:
-                    os.environ.pop("TEMPO_TPU_ZONEMAPS", None)
+                    for k in env:
+                        os.environ.pop(k, None)
                 arms[arm] = {
                     "s": float(np.median(times)),
                     "bytes": resp.inspected_bytes,
+                    "decoded": resp.decoded_bytes,
                     "pruned_row_groups": resp.pruned_row_groups,
                     "coalesced_reads": resp.coalesced_reads,
                 }
                 hitsets[arm] = {t.trace_id_hex for t in resp.traces}
                 totals[arm]["s"] += arms[arm]["s"]
                 totals[arm]["bytes"] += arms[arm]["bytes"]
-            parity = hitsets["pruned"] == hitsets["unpruned"]
+                totals[arm]["decoded"] += arms[arm]["decoded"]
+            parity = all(hitsets[a] == hitsets["pruned"] for a in ARMS)
             parity_all = parity_all and parity
             if not parity:
                 print(f"[bench] WARNING: search rep {qname!r} hit sets DIFFER "
-                      f"between pruned and unpruned arms", file=sys.stderr)
+                      f"across arms", file=sys.stderr)
             per_query[qname] = {
                 "pruned_s": round(arms["pruned"]["s"], 4),
                 "unpruned_s": round(arms["unpruned"]["s"], 4),
                 "speedup": round(arms["unpruned"]["s"] / max(arms["pruned"]["s"], 1e-9), 3),
                 "bytes_ratio": round(
                     arms["unpruned"]["bytes"] / max(arms["pruned"]["bytes"], 1), 3),
+                "decoded_bytes": arms["pruned"]["decoded"],
+                "decoded_bytes_rowspace": arms["rowspace"]["decoded"],
+                # decodedBytes vs HEAD: the rowspace arm decodes exactly
+                # what the pre-tier read path decoded
+                "decoded_ratio": round(
+                    arms["rowspace"]["decoded"] / max(arms["pruned"]["decoded"], 1), 3),
                 "pruned_row_groups": arms["pruned"]["pruned_row_groups"],
                 "coalesced_reads": arms["pruned"]["coalesced_reads"],
                 "hits": len(hitsets["pruned"]),
@@ -303,9 +329,14 @@ def _search_rep(reps: int = 3) -> dict:
             **per_query,
             "inspected_bytes_pruned": totals["pruned"]["bytes"],
             "inspected_bytes_unpruned": totals["unpruned"]["bytes"],
+            "decoded_bytes_runspace": totals["pruned"]["decoded"],
+            "decoded_bytes_rowspace": totals["rowspace"]["decoded"],
+            "decoded_ratio": round(
+                totals["rowspace"]["decoded"] / max(totals["pruned"]["decoded"], 1), 3),
             "bytes_ratio": round(
                 totals["unpruned"]["bytes"] / max(totals["pruned"]["bytes"], 1), 3),
             "speedup": round(totals["unpruned"]["s"] / max(totals["pruned"]["s"], 1e-9), 3),
+            "legacy_s": round(totals["legacy"]["s"], 4),
             "parity": parity_all,
         }
     finally:
@@ -339,13 +370,22 @@ def _metrics_rep(reps: int = 3) -> dict:
         # row group of one block + everything in every dictionary, so
         # pruning must come from presence sets, not dictionary misses
         metas = _search_inputs(backend, cfg)
+        # legacy-codec arm: the SAME data written without the lightweight
+        # tier (entropy pages only) must produce the same matrix
+        os.environ["TEMPO_TPU_LIGHTWEIGHT"] = "0"
+        try:
+            legacy_backend = TypedBackend(LocalBackend(os.path.join(tmp.name, "legacy")))
+            legacy_metas = _search_inputs(legacy_backend, cfg)
+        finally:
+            os.environ.pop("TEMPO_TPU_LIGHTWEIGHT", None)
         start, end, step = 1_700_000_000, 1_700_000_060, 10
         queries = {
             "rate": "{ resource.service.name = `needle-svc` } | rate() by (name)",
             "quantile": "{} | quantile_over_time(duration, 0.5, 0.99)",
         }
 
-        def run_once(q: str, device: bool, zonemaps: bool) -> "HostAccumulator":
+        def run_once(q: str, device: bool, zonemaps: bool,
+                     legacy: bool = False) -> "HostAccumulator":
             cache = shared_cache()
             if cache is not None:
                 cache.clear()  # every run pays its own IO
@@ -353,10 +393,12 @@ def _metrics_rep(reps: int = 3) -> dict:
             try:
                 plan = compile_metrics_plan(q, start, end, step)
                 acc = make_accumulator(plan, device=device)
-                for m in metas:
-                    blk = enc.open_block(m, backend, cfg)
+                ms, be = (legacy_metas, legacy_backend) if legacy else (metas, backend)
+                for m in ms:
+                    blk = enc.open_block(m, be, cfg)
                     evaluate_block(plan, blk, acc)
                     acc.stats["inspectedBytes"] += blk.bytes_read
+                    acc.stats["decodedBytes"] += blk.decoded_bytes
                 acc.merged_counts()  # drain device buffers inside the clock
                 return acc
             finally:
@@ -367,20 +409,33 @@ def _metrics_rep(reps: int = 3) -> dict:
         for qname, q in queries.items():
             arms: dict[str, dict] = {}
             counts: dict[str, np.ndarray] = {}
-            for arm, device in (("device", True), ("host", False)):
-                run_once(q, device, True)  # warmup: jit compiles + page cache
-                times = []
-                for _ in range(reps):
-                    t0 = time.perf_counter()
-                    acc = run_once(q, device, True)
-                    times.append(time.perf_counter() - t0)
+            # INTERLEAVED device/host reps with a paired per-rep ratio —
+            # same discipline as the headline bench: epoch noise hits
+            # both arms of a pair, so the ratio is stable even when the
+            # absolute times wander
+            run_once(q, True, True)   # warmup: jit compiles + page cache
+            run_once(q, False, True)
+            t_dev, t_host = [], []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                acc_dev = run_once(q, True, True)
+                t_dev.append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                acc_host = run_once(q, False, True)
+                t_host.append(time.perf_counter() - t0)
+            for arm, acc, times in (("device", acc_dev, t_dev),
+                                    ("host", acc_host, t_host)):
                 arms[arm] = {"s": float(np.median(times)),
-                             "bytes": acc.stats["inspectedBytes"]}
+                             "bytes": acc.stats["inspectedBytes"],
+                             "decoded": acc.stats["decodedBytes"]}
                 counts[arm] = acc.merged_counts()
+            paired = float(np.median([h / d for h, d in zip(t_host, t_dev)]))
             unpruned = run_once(q, False, False)
+            legacy_acc = run_once(q, False, True, legacy=True)
             parity = bool(
                 (counts["device"] == counts["host"]).all()
                 and (counts["host"] == unpruned.merged_counts()).all()
+                and (counts["host"] == legacy_acc.merged_counts()).all()
             )
             parity_all = parity_all and parity
             if not parity:
@@ -389,7 +444,9 @@ def _metrics_rep(reps: int = 3) -> dict:
             out[qname] = {
                 "device_s": round(arms["device"]["s"], 4),
                 "host_s": round(arms["host"]["s"], 4),
+                "device_vs_host": round(paired, 3),
                 "inspected_bytes": arms["host"]["bytes"],
+                "decoded_bytes": arms["host"]["decoded"],
                 "inspected_bytes_unpruned": unpruned.stats["inspectedBytes"],
                 "bytes_ratio": round(
                     unpruned.stats["inspectedBytes"] / max(arms["host"]["bytes"], 1), 3),
@@ -401,6 +458,82 @@ def _metrics_rep(reps: int = 3) -> dict:
         return out
     finally:
         tmp.cleanup()
+
+
+def _decode_rep(reps: int = 5) -> dict:
+    """Per-codec decode throughput (MB/s of DECODED payload): the host
+    entropy tier (zstd_shuffle via the native lib, zlib fallback) vs the
+    lightweight encodings on the host vs the device/jit arm
+    (ops/pallas_kernels dbp two-limb-scan decode + rle expand). Captures
+    the codec trajectory the zero-decode read path is built on — the
+    bench JSON carries one row per (codec, arm)."""
+    from tempo_tpu.encoding.vtpu import codec as codec_mod
+    from tempo_tpu.encoding.vtpu import lightweight as lw
+    from tempo_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.default_rng(42)
+    n = 1 << 20
+    cols = {
+        # near-sorted timestamps: the dbp shape
+        "dbp": (np.uint64(1.7e18) + rng.integers(0, 1000, n).cumsum()).astype(np.uint64),
+        # run-heavy dictionary codes: the rle shape
+        "rle": np.repeat(rng.integers(0, 64, n // 8).astype(np.uint32), 8),
+        # low-cardinality, short runs: the dct shape
+        "dct": rng.integers(0, 200, n).astype(np.uint32),
+        # high-entropy: stays on the entropy tier
+        "entropy": rng.integers(0, 2**62, n).astype(np.uint64),
+    }
+    entropy_codec = codec_mod.best_codec()
+
+    def mb_s(fn, payload_bytes) -> float:
+        fn()  # warm (jit compiles, page cache)
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            times.append(time.perf_counter() - t0)
+        return round(payload_bytes / float(np.median(times)) / 2**20, 1)
+
+    out: dict = {}
+    for kind, arr in cols.items():
+        codec = entropy_codec if kind == "entropy" else kind
+        page, crc = codec_mod.encode(arr, codec)
+        row = {
+            "codec": codec,
+            "ratio": round(arr.nbytes / max(len(page), 1), 2),
+            "host_mb_s": mb_s(
+                lambda: codec_mod.decode(page, arr.dtype.str, arr.shape, codec, crc),
+                arr.nbytes),
+        }
+        if codec == "dbp":
+            row["device_mb_s"] = mb_s(
+                lambda: pk.dbp_decode_device(page, arr.dtype.str, arr.shape),
+                arr.nbytes)
+        elif kind == "entropy":
+            # the byte-unshuffle stage of zstd_shuffle on device: host
+            # pays the entropy decode, the shifts+ors transpose lands
+            # next to the predicate math
+            planes = np.ascontiguousarray(
+                arr.view(np.uint8).reshape(-1, arr.dtype.itemsize).T)
+            row["device_unshuffle_mb_s"] = mb_s(
+                lambda: np.asarray(pk.unshuffle_device(planes[:4], 4)),
+                arr.nbytes // 2)
+        elif codec == "rle":
+            values, lengths = lw.rle_decode_runs(page, arr.dtype.str, arr.shape)
+            v32 = values.astype(np.uint32)
+            l32 = lengths.astype(np.int32)
+            row["device_mb_s"] = mb_s(
+                lambda: np.asarray(pk.rle_expand_device(v32, l32, n)), arr.nbytes)
+        out[kind] = row
+        print(f"[bench] decode {kind}: {row}", file=sys.stderr)
+    # reference point: the entropy tier decoding the SAME dbp-shaped
+    # column (what every query paid before the lightweight tier)
+    t = cols["dbp"]
+    page, crc = codec_mod.encode(t, entropy_codec)
+    out["dbp_on_entropy_host_mb_s"] = mb_s(
+        lambda: codec_mod.decode(page, t.dtype.str, t.shape, entropy_codec, crc),
+        t.nbytes)
+    return out
 
 
 class Arm:
@@ -734,6 +867,10 @@ def _run(dog, partial: dict):
     partial["metrics"] = metrics_rep
     print(f"[bench] metrics: {metrics_rep}", file=sys.stderr)
 
+    # per-codec decode MB/s: the lightweight-tier trajectory (ISSUE 7)
+    decode_rep = _decode_rep()
+    partial["decode"] = decode_rep
+
     med, spread = _stats(tpu_times)
     blocks_per_s = B_BLOCKS / med
     # paired per-rep ratios: epoch noise hits both arms of a pair, so the
@@ -777,6 +914,7 @@ def _run(dog, partial: dict):
         "fastpath": fastpath,
         "search": search_rep,
         "metrics": metrics_rep,
+        "decode": decode_rep,
     }))
 
 

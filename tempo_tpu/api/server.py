@@ -474,6 +474,7 @@ class _Handler(BaseHTTPRequestHandler):
         stats = doc.pop("stats", {})
         stats["elapsedMs"] = int((time.monotonic() - t0) * 1000)
         stats["inspectedBytes"] = str(stats.get("inspectedBytes", 0))
+        stats["decodedBytes"] = str(stats.get("decodedBytes", 0))
         self._send_json(200, {
             # "partial" when terminal shard failures stayed within the
             # tenant's failed-shard budget (stats.failedShards says how
@@ -506,6 +507,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "metrics": {
                     "inspectedTraces": stats.get("inspectedTraces", 0),
                     "inspectedBytes": str(stats.get("inspectedBytes", 0)),
+                    "decodedBytes": str(stats.get("decodedBytes", 0)),
                     "inspectedBlocks": stats.get("inspectedBlocks", 0),
                     "elapsedMs": int((time.monotonic() - t0) * 1000),
                 },
@@ -517,6 +519,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "metrics": {
                     "inspectedTraces": resp.inspected_traces,
                     "inspectedBytes": str(resp.inspected_bytes),
+                    "decodedBytes": str(resp.decoded_bytes),
                     "inspectedBlocks": resp.inspected_blocks,
                 },
             }

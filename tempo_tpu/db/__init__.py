@@ -441,7 +441,8 @@ class TempoDB:
             # and a shared dict bump would race
             return (out, getattr(blk, "bytes_read", 0),
                     getattr(blk, "pruned_row_groups", 0),
-                    getattr(blk, "coalesced_reads", 0))
+                    getattr(blk, "coalesced_reads", 0),
+                    getattr(blk, "decoded_bytes", 0))
 
         results, errors = self.pool.run_jobs(
             [lambda m=m: self.guard_block(tenant, m.block_id, lambda: job(m)) for m in metas]
@@ -450,11 +451,12 @@ class TempoDB:
         if fatal:
             raise fatal[0]
         by_id: dict[bytes, list] = {}
-        for traces, bytes_read, pruned, coalesced in results:
+        for traces, bytes_read, pruned, coalesced, decoded in results:
             if stats is not None:
                 stats["inspectedBytes"] = stats.get("inspectedBytes", 0) + bytes_read
                 stats["prunedRowGroups"] = stats.get("prunedRowGroups", 0) + pruned
                 stats["coalescedReads"] = stats.get("coalescedReads", 0) + coalesced
+                stats["decodedBytes"] = stats.get("decodedBytes", 0) + decoded
             for t in traces:
                 by_id.setdefault(t.trace_id, []).append(t)
 
@@ -502,11 +504,12 @@ class TempoDB:
         from tempo_tpu.traceql import execute, vector
         from tempo_tpu.traceql.parser import parse
 
-        def bump(bytes_=0, traces=0, blocks=0):
+        def bump(bytes_=0, traces=0, blocks=0, decoded=0):
             if stats is not None:
                 stats["inspectedBytes"] = stats.get("inspectedBytes", 0) + int(bytes_)
                 stats["inspectedTraces"] = stats.get("inspectedTraces", 0) + int(traces)
                 stats["inspectedBlocks"] = stats.get("inspectedBlocks", 0) + int(blocks)
+                stats["decodedBytes"] = stats.get("decodedBytes", 0) + int(decoded)
 
         pipeline = parse(query)
         metas = [m for m in self.blocklist.metas(tenant) if _overlaps(m, start_s, end_s)]
@@ -536,7 +539,7 @@ class TempoDB:
                             local[tid].merge(p)
                         else:
                             local[tid] = p
-                return local, blk.bytes_read, n_traces, seen_tids
+                return local, blk.bytes_read, n_traces, seen_tids, blk.decoded_bytes
 
             results, errors = self.pool.run_jobs(
                 [lambda m=m: self.guard_block(tenant, m.block_id, lambda: job(m),
@@ -546,7 +549,7 @@ class TempoDB:
             straddled = False
             if structural and not _fatal(errors):
                 counts: dict = {}
-                for _local, _b, _n, seen in results:
+                for _local, _b, _n, seen, _d in results:
                     for tid in seen:
                         counts[tid] = counts.get(tid, 0) + 1
                 straddled = any(c > 1 for c in counts.values())
@@ -559,8 +562,8 @@ class TempoDB:
                 raise _fatal(errors)[0]
             else:
                 partials: dict = {}
-                for local, bytes_read, n_traces, _seen in results:
-                    bump(bytes_=bytes_read, traces=n_traces, blocks=1)
+                for local, bytes_read, n_traces, _seen, decoded in results:
+                    bump(bytes_=bytes_read, traces=n_traces, blocks=1, decoded=decoded)
                     for tid, p in local.items():
                         if tid in partials:
                             partials[tid].merge(p)

@@ -135,6 +135,11 @@ class TraceSearchMetadata:
 class SearchResponse:
     traces: list = field(default_factory=list)  # TraceSearchMetadata
     inspected_bytes: int = 0
+    # column value bytes materialized into row space by decode work —
+    # with run/dict-space evaluation this tracks the selectivity (the
+    # surviving bytes), not the row count; the ROADMAP north-star is
+    # inspectedBytes ≈ decodedBytes ≈ transferred bytes
+    decoded_bytes: int = 0
     inspected_traces: int = 0
     inspected_blocks: int = 0
     # read-path economy (zone maps + coalescing): row groups skipped
@@ -162,6 +167,7 @@ class SearchResponse:
         if limit:
             self.traces = self.traces[:limit]
         self.inspected_bytes += other.inspected_bytes
+        self.decoded_bytes += other.decoded_bytes
         self.inspected_traces += other.inspected_traces
         self.inspected_blocks += other.inspected_blocks
         self.pruned_row_groups += other.pruned_row_groups
@@ -176,6 +182,7 @@ class SearchResponse:
             "metrics": {
                 "inspectedTraces": self.inspected_traces,
                 "inspectedBytes": str(self.inspected_bytes),
+                "decodedBytes": str(self.decoded_bytes),
                 "inspectedBlocks": self.inspected_blocks,
                 "prunedRowGroups": self.pruned_row_groups,
                 "coalescedReads": self.coalesced_reads,
@@ -204,6 +211,7 @@ class SearchResponse:
         m = doc.get("metrics", {})
         resp.inspected_traces = m.get("inspectedTraces", 0)
         resp.inspected_bytes = int(m.get("inspectedBytes", "0"))
+        resp.decoded_bytes = int(m.get("decodedBytes", "0"))
         resp.inspected_blocks = m.get("inspectedBlocks", 0)
         resp.pruned_row_groups = m.get("prunedRowGroups", 0)
         resp.coalesced_reads = m.get("coalescedReads", 0)

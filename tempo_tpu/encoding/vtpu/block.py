@@ -71,6 +71,22 @@ coalesced_reads_total = metrics.counter(
     "tempodb_search_coalesced_reads_total",
     "Backend round trips saved by coalescing page reads",
 )
+decoded_bytes_total = metrics.counter(
+    "tempodb_decoded_bytes_total",
+    "Column value bytes materialized into row space by decode work "
+    "(run/dictionary-space reads count their encoded size; selective "
+    "gathers count the rows/miniblocks touched)",
+)
+
+
+def runspace_enabled() -> bool:
+    """Run-space evaluation kill switch (TEMPO_TPU_RUNSPACE=0): the
+    bench's row-space A/B arm and the operator escape hatch. Off means
+    every predicate/gather expands full columns, exactly the pre-tier
+    read path; results are bit-identical either way."""
+    return os.environ.get("TEMPO_TPU_RUNSPACE", "1").strip().lower() not in (
+        "0", "false", "no",
+    )
 
 
 def zone_maps_enabled() -> bool:
@@ -122,6 +138,170 @@ def zone_prunes(rg: fmt.RowGroupMeta, preds, req: SearchRequest) -> bool:
     return False
 
 
+class EncodedColumn:
+    """Predicate/gather access to ONE column page in its encoded space
+    (lightweight tier only — encoding/vtpu/lightweight.py).
+
+    eq/in_set/between evaluate per RUN (rle) or per page-DICTIONARY
+    entry (dct) and the verdict expands as one bool per row: the values
+    of unselected runs are never materialized. gather() reads only the
+    requested rows (rle: run lookup; dct: bit windows; dbp: miniblocks).
+    Every operation reports what it materialized to the owning block's
+    decoded_bytes counter, so decodedBytes tracks the selectivity, not
+    the row count.
+    """
+
+    def __init__(self, blk: "VtpuBackendBlock", rg, name: str):
+        self.blk = blk
+        self.rg = rg
+        self.name = name
+        self.pm = rg.pages[name]
+        self.codec = self.pm.codec
+        self.n = self.pm.shape[0] if self.pm.shape else 0
+
+    # -- raw page bytes (cached process-wide; misses pay one ranged read)
+    def _page(self) -> bytes:
+        blk, pm = self.blk, self.pm
+        cache = blk._colcache
+        key = (blk.meta.block_id, self.name, pm.offset, "page")
+        if cache is not None:
+            hit = cache.get(key)
+            if hit is not None:
+                return hit.tobytes()
+        page = blk._reader()(pm.offset, pm.length)
+        if cache is not None:
+            cache.put(key, np.frombuffer(page, np.uint8))
+        return page
+
+    def runs(self):
+        """(values, lengths) of an rle page — the run-space read."""
+        from tempo_tpu.encoding.vtpu import lightweight as lw
+
+        blk, pm = self.blk, self.pm
+        cache = blk._colcache
+        kv = (blk.meta.block_id, self.name, pm.offset, "runv")
+        kl = (blk.meta.block_id, self.name, pm.offset, "runl")
+        if cache is not None:
+            values, lengths = cache.get(kv), cache.get(kl)
+            if values is not None and lengths is not None:
+                return values, lengths
+        values, lengths = lw.rle_decode_runs(self._page(), pm.dtype, pm.shape)
+        blk._account_decoded(values.nbytes + lengths.nbytes)
+        if cache is not None:
+            cache.put(kv, values)
+            cache.put(kl, lengths)
+        return values, lengths
+
+    def _dct_indices(self):
+        from tempo_tpu.encoding.vtpu import lightweight as lw
+
+        blk, pm = self.blk, self.pm
+        cache = blk._colcache
+        kv = (blk.meta.block_id, self.name, pm.offset, "dctv")
+        ki = (blk.meta.block_id, self.name, pm.offset, "dcti")
+        if cache is not None:
+            values, idx = cache.get(kv), cache.get(ki)
+            if values is not None and idx is not None:
+                return values, idx
+        values, idx = lw.dct_indices(self._page(), pm.dtype, pm.shape)
+        # index expansion materializes no values: count the packed
+        # stream's size (width bits per row), i.e. the encoded form
+        w = max(values.shape[0] - 1, 0).bit_length()
+        blk._account_decoded(values.nbytes + (self.n * w + 7) // 8)
+        if cache is not None:
+            cache.put(kv, values)
+            cache.put(ki, idx)
+        return values, idx
+
+    # -- predicate evaluation in encoded space -------------------------
+    def in_set_mask(self, codes: np.ndarray, invert: bool = False):
+        """Row mask for `column in codes` (1-D columns), or None when
+        this codec cannot answer without full decode (dbp)."""
+        from tempo_tpu.ops import scan
+
+        if self.codec == "rle":
+            values, lengths = self.runs()
+            return scan.expand_run_mask(
+                scan.in_set_runs(values, codes, invert=invert), lengths, self.n)
+        if self.codec == "dct":
+            values, idx = self._dct_indices()
+            hit = np.isin(values, codes, invert=invert)
+            return hit[idx] if self.n else np.zeros(0, bool)
+        return None
+
+    def range_mask(self, lo, hi):
+        """Row mask for lo <= column <= hi, or None (dbp/entropy)."""
+        from tempo_tpu.ops import scan
+
+        if self.codec == "rle":
+            values, lengths = self.runs()
+            return scan.expand_run_mask(
+                scan.between_runs(values, lo, hi), lengths, self.n)
+        if self.codec == "dct":
+            values, idx = self._dct_indices()
+            hit = (values >= lo) & (values <= hi)
+            return hit[idx] if self.n else np.zeros(0, bool)
+        return None
+
+    def map_mask(self, fn) -> np.ndarray | None:
+        """Row mask from an arbitrary per-VALUE boolean predicate: fn
+        runs once per run (rle) or page-dictionary entry (dct) — never
+        per row — and the verdict expands. fn must be elementwise (the
+        same value always gets the same verdict), which is what makes
+        the run verdict the row verdict."""
+        from tempo_tpu.ops import scan
+
+        if self.codec == "rle":
+            values, lengths = self.runs()
+            return scan.expand_run_mask(np.asarray(fn(values), bool), lengths, self.n)
+        if self.codec == "dct":
+            values, idx = self._dct_indices()
+            hit = np.asarray(fn(values), bool)
+            return hit[idx] if self.n else np.zeros(0, bool)
+        return None
+
+    def rows_equal_mask(self, target_row) -> np.ndarray | None:
+        """Row mask for `row == target_row` on vector columns (limb
+        arrays) — the parent==0 root test without expanding IDs."""
+        if self.codec == "rle":
+            values, lengths = self.runs()
+            from tempo_tpu.ops import scan
+
+            hit = (values == target_row).all(axis=tuple(range(1, values.ndim)))
+            return scan.expand_run_mask(hit, lengths, self.n)
+        if self.codec == "dct":
+            values, idx = self._dct_indices()
+            hit = (values == target_row).all(axis=tuple(range(1, values.ndim)))
+            return hit[idx] if self.n else np.zeros(0, bool)
+        return None
+
+    # -- selective materialization -------------------------------------
+    def gather(self, rows: np.ndarray) -> np.ndarray:
+        """Values at `rows` only. rle/dct/dbp pay the rows (and, for
+        dbp, the miniblocks) touched; anything else falls back to the
+        full-column read (counted as such)."""
+        from tempo_tpu.encoding.vtpu import lightweight as lw
+
+        rows = np.asarray(rows, np.int64)
+        pm = self.pm
+        if self.codec == "rle":
+            values, lengths = self.runs()
+            out = lw.rle_gather(values, lengths, rows)
+            self.blk._account_decoded(out.nbytes)
+            return out
+        if self.codec == "dct":
+            out = lw.dct_gather(self._page(), pm.dtype, pm.shape, rows)
+            self.blk._account_decoded(out.nbytes)
+            return out
+        if self.codec == "dbp":
+            out, touched_rows = lw.dbp_gather(self._page(), pm.dtype, pm.shape, rows)
+            self.blk._account_decoded(touched_rows * np.dtype(pm.dtype).itemsize
+                                      * (out.shape[1] if out.ndim > 1 else 1))
+            return out
+        col = self.blk.read_columns(self.rg, [self.name])[self.name]
+        return col[rows]
+
+
 class VtpuBackendBlock:
     """Lazy reader over one block; caches index + dictionary."""
 
@@ -139,6 +319,13 @@ class VtpuBackendBlock:
         # snapshots them into per-response stats)
         self.pruned_row_groups = 0
         self.coalesced_reads = 0  # backend round trips SAVED by coalescing
+        # column value bytes materialized into row space by decode work.
+        # Cache hits cost no decode and are not counted (same convention
+        # as bytes_read); run/dict-space reads count their encoded size;
+        # selective gathers count the rows/miniblocks touched — so on a
+        # selective query this tracks the surviving bytes, not the row
+        # count (the ROADMAP "inspectedBytes ≈ decodedBytes" target)
+        self.decoded_bytes = 0
         # counter guard: the prefetcher loads row group N+1's column on a
         # worker thread while the caller reads N's remaining columns
         self._io_lock = threading.Lock()
@@ -193,6 +380,11 @@ class VtpuBackendBlock:
 
         return read
 
+    def _account_decoded(self, nbytes: int) -> None:
+        with self._io_lock:
+            self.decoded_bytes += nbytes
+        decoded_bytes_total.inc(nbytes)
+
     def _fetch_columns(self, rg: fmt.RowGroupMeta, names: list[str]) -> dict[str, np.ndarray]:
         """Fetch+decode columns with coalesced ranged reads, accounting
         the round trips saved vs one-read-per-page."""
@@ -202,7 +394,34 @@ class VtpuBackendBlock:
             with self._io_lock:
                 self.coalesced_reads += saved
             coalesced_reads_total.inc(saved)
+        self._account_decoded(sum(c.nbytes for c in cols.values()))
         return cols
+
+    def encoded_column(self, rg: fmt.RowGroupMeta, name: str) -> EncodedColumn | None:
+        """Encoded-space access to one column, or None when its page is
+        on the entropy tier (or run-space evaluation is switched off)."""
+        from tempo_tpu.encoding.vtpu.codec import LIGHTWEIGHT_CODECS
+
+        if not runspace_enabled():
+            return None
+        pm = rg.pages.get(name)
+        if pm is None or pm.codec not in LIGHTWEIGHT_CODECS:
+            return None
+        return EncodedColumn(self, rg, name)
+
+    def column_in_set_mask(self, rg: fmt.RowGroupMeta, name: str,
+                           codes: np.ndarray, invert: bool = False) -> np.ndarray:
+        """Span mask for `column in codes`, evaluated in run/dictionary
+        space when the page allows (values of unselected runs never
+        expand), else over the decoded column — bit-identical either
+        way."""
+        enc = self.encoded_column(rg, name)
+        if enc is not None:
+            m = enc.in_set_mask(codes, invert=invert)
+            if m is not None:
+                return m
+        c = self.read_columns(rg, [name])[name]
+        return np.isin(c, codes, invert=invert)
 
     def read_columns(self, rg: fmt.RowGroupMeta, names: list[str]) -> dict[str, np.ndarray]:
         """Decoded column chunks, via the process-wide cache when armed.
@@ -294,6 +513,7 @@ class VtpuBackendBlock:
         from tempo_tpu.util.pipeline import ReadAhead
 
         bytes_before = self.bytes_read
+        decoded_before = self.decoded_bytes
         coalesced_before = self.coalesced_reads
         resp = SearchResponse(inspected_blocks=1)
         d = self.dictionary()
@@ -324,12 +544,24 @@ class VtpuBackendBlock:
 
             # prefetch: load row group N+1's first predicate column while
             # N evaluates (no-op on single-core hosts — ReadAhead gates
-            # its worker on pipeline.overlap_enabled)
+            # its worker on pipeline.overlap_enabled). Encoded-evaluable
+            # pages prefetch their raw bytes only (the IO); the run/dict
+            #-space verdict is cheap and computed inline.
             stage1 = ([preds["span_eq"][0][0]] if preds["span_eq"]
                       else ["duration_nano"]
                       if (req.min_duration_ns or req.max_duration_ns) else [])
-            ra = ReadAhead(lambda i: self.read_columns(live[i], stage1),
-                           len(live)) if stage1 and live else None
+
+            def load_stage1(i):
+                out = {}
+                for nm in stage1:
+                    enc = self.encoded_column(live[i], nm)
+                    if enc is not None:
+                        enc._page()  # warm the raw-page cache
+                    else:
+                        out.update(self.read_columns(live[i], [nm]))
+                return out
+
+            ra = ReadAhead(load_stage1, len(live)) if stage1 and live else None
             try:
                 for i, rg in enumerate(live):
                     resp.inspected_traces += rg.n_traces
@@ -343,6 +575,7 @@ class VtpuBackendBlock:
                 if ra is not None:
                     ra.close()
         resp.inspected_bytes = self.bytes_read - bytes_before
+        resp.decoded_bytes = self.decoded_bytes - decoded_before
         resp.coalesced_reads = self.coalesced_reads - coalesced_before
         return resp
 
@@ -363,28 +596,49 @@ class VtpuBackendBlock:
         span_mask = np.ones(n, bool)
         dur_pred = bool(req.min_duration_ns or req.max_duration_ns)
 
+        def expandable(name: str) -> bool:
+            # a column whose predicate evaluates in encoded space never
+            # joins a coalesced full read
+            return self.encoded_column(rg, name) is not None
+
         for k, (col, codes) in enumerate(preds["span_eq"]):
+            m = None
             if col not in cols:
-                if k == 0:
-                    cols.update(self.read_columns(rg, [col]))
-                else:
-                    # the mask survived the most selective predicate:
-                    # fetch everything still needed in one coalesced read
-                    rest = [c for c, _ in preds["span_eq"][k:] if c not in cols]
-                    if dur_pred and "duration_nano" not in cols:
-                        rest.append("duration_nano")
-                    cols.update(self.read_columns(rg, rest))
-            span_mask &= np.isin(cols[col], codes)
+                enc = self.encoded_column(rg, col)
+                if enc is not None:
+                    m = enc.in_set_mask(codes)
+            if m is None:
+                if col not in cols:
+                    if k == 0:
+                        cols.update(self.read_columns(rg, [col]))
+                    else:
+                        # the mask survived the most selective predicate:
+                        # fetch everything still needed in one coalesced
+                        # read (encoded-evaluable columns excluded)
+                        rest = [c for c, _ in preds["span_eq"][k:]
+                                if c not in cols and not expandable(c)]
+                        if dur_pred and "duration_nano" not in cols \
+                                and not expandable("duration_nano"):
+                            rest.append("duration_nano")
+                        cols.update(self.read_columns(rg, rest))
+                m = np.isin(cols[col], codes)
+            span_mask &= m
             if not span_mask.any():
                 return []
         if dur_pred:
+            lo = req.min_duration_ns or 0
+            hi = req.max_duration_ns or ((1 << 64) - 1)
+            m = None
             if "duration_nano" not in cols:
-                cols.update(self.read_columns(rg, ["duration_nano"]))
-            dur = cols["duration_nano"]
-            if req.min_duration_ns:
-                span_mask &= dur >= np.uint64(req.min_duration_ns)
-            if req.max_duration_ns:
-                span_mask &= dur <= np.uint64(req.max_duration_ns)
+                enc = self.encoded_column(rg, "duration_nano")
+                if enc is not None:
+                    m = enc.range_mask(np.uint64(lo), np.uint64(hi))
+            if m is None:
+                if "duration_nano" not in cols:
+                    cols.update(self.read_columns(rg, ["duration_nano"]))
+                dur = cols["duration_nano"]
+                m = (dur >= np.uint64(lo)) & (dur <= np.uint64(hi))
+            span_mask &= m
             if not span_mask.any():
                 return []
 
@@ -401,6 +655,14 @@ class VtpuBackendBlock:
         mask up to TraceSearchMetadata (also the mesh scan's collector —
         the scan produces the mask, this builds the hits).
 
+        With an RLE trace-ID page the whole phase runs in RUN SPACE:
+        the ID runs ARE the trace segmentation (zero decode), and the
+        metadata columns are GATHERED for the hit traces' rows only —
+        the surviving-span selection pushed into the later column reads,
+        so decodedBytes scales with the hits, not the row count. The
+        row-space path below is the exact fallback (and the
+        TEMPO_TPU_RUNSPACE=0 arm); both produce identical hits.
+
         The rollup is fully vectorized (reduceat over trace segments):
         the per-hit Python work is only dataclass construction, so
         unlimited searches don't pay a numpy call per trace.
@@ -408,6 +670,12 @@ class VtpuBackendBlock:
         n = rg.n_spans
         if n == 0:
             return []
+        tid_enc = self.encoded_column(rg, "trace_id")
+        if tid_enc is not None and tid_enc.codec == "rle":
+            out = self._hits_for_mask_runspace(
+                rg, tid_enc, span_mask, req, limit, have_cols)
+            if out is not None:
+                return out
         cols = dict(have_cols or {})
         missing = sorted(set(_META_COLS) - set(cols))
         if missing:
@@ -459,6 +727,105 @@ class VtpuBackendBlock:
             )
         return out
 
+
+    def _hits_for_mask_runspace(self, rg, tid_enc: EncodedColumn,
+                                span_mask: np.ndarray, req, limit: int,
+                                have_cols: dict | None) -> list | None:
+        """Run-space hit collection: trace segmentation from the RLE
+        trace-ID runs (the runs ARE the traces — rows are trace-sorted,
+        so equal IDs form maximal stretches, exactly
+        trace_segmentation's rule), metadata gathered for hit-trace rows
+        only. Bit-identical to the row-space rollup."""
+        from tempo_tpu.model.columnar import hit_trace_mask
+        from tempo_tpu.ops import scan
+
+        n = rg.n_spans
+        have = dict(have_cols or {})
+
+        def g(name: str, rows: np.ndarray) -> np.ndarray:
+            if name in have:
+                return have[name][rows]
+            enc = self.encoded_column(rg, name)
+            if enc is not None:
+                return enc.gather(rows)
+            return self.read_columns(rg, [name])[name][rows]
+
+        values, lengths = tid_enc.runs()
+        firsts, seg = scan.runs_firsts_seg(lengths)
+        n_traces = len(lengths)
+        if n_traces == 0:
+            return []
+
+        mask = span_mask
+        if req.start_seconds or req.end_seconds:
+            rows_m = np.flatnonzero(mask)
+            if not len(rows_m):
+                return []
+            starts_m = g("start_unix_nano", rows_m)
+            ends_m = starts_m + g("duration_nano", rows_m)
+            keep = np.ones(len(rows_m), bool)
+            if req.start_seconds:
+                keep &= ends_m >= np.uint64(req.start_seconds * 10**9)
+            if req.end_seconds:
+                keep &= starts_m <= np.uint64(req.end_seconds * 10**9)
+            mask = np.zeros(n, bool)
+            mask[rows_m[keep]] = True
+
+        trace_hit = hit_trace_mask(seg, mask, n_traces)
+        hit_ts = np.flatnonzero(trace_hit)
+        if limit > 0:
+            hit_ts = hit_ts[:limit]
+        if not len(hit_ts):
+            return []
+
+        # all rows of the hit traces (the per-trace metadata reductions
+        # run over the trace's own rows, matched or not)
+        bounds_next = np.append(firsts[1:], n)
+        counts = bounds_next[hit_ts] - firsts[hit_ts]
+        tot = int(counts.sum())
+        hfirsts = np.cumsum(counts) - counts
+        offs = np.arange(tot, dtype=np.int64) - np.repeat(hfirsts, counts)
+        rows = np.repeat(firsts[hit_ts], counts) + offs
+
+        starts_h = g("start_unix_nano", rows)
+        ends_h = starts_h + g("duration_nano", rows)
+        t_start = np.minimum.reduceat(starts_h, hfirsts)
+        t_end = np.maximum.reduceat(ends_h, hfirsts)
+        # first TRUE-root row per hit trace, else the trace's first row.
+        # The write-time root_first stat proves the answer is the first
+        # row for every trace here — zero parent reads; otherwise scan
+        # the hit traces' parent ids.
+        if rg.stats and rg.stats.get("root_first"):
+            root_rows = firsts[hit_ts]
+        else:
+            par_enc = self.encoded_column(rg, "parent_span_id")
+            root_mask = par_enc.rows_equal_mask(0) if par_enc is not None else None
+            if root_mask is not None:
+                is_root = root_mask[rows]  # run/dict-space zero test
+            else:
+                is_root = (g("parent_span_id", rows) == 0).all(axis=1)
+            cand = np.where(is_root, rows, n)
+            first_root = np.minimum.reduceat(cand, hfirsts)
+            root_rows = np.where(first_root < bounds_next[hit_ts],
+                                 first_root, firsts[hit_ts])
+        svc = g("service", root_rows)
+        nm = g("name", root_rows)
+
+        d = self.dictionary()
+        tid_be = np.ascontiguousarray(values[hit_ts]).astype(">u4")
+        out = []
+        for j in range(len(hit_ts)):
+            s = int(t_start[j])
+            out.append(
+                TraceSearchMetadata(
+                    trace_id_hex=tid_be[j].tobytes().hex(),
+                    root_service_name=d[int(svc[j])],
+                    root_trace_name=d[int(nm[j])],
+                    start_time_unix_nano=s,
+                    duration_ms=(int(t_end[j]) - s) // 10**6,
+                )
+            )
+        return out
 
     # ------------------------------------------------------------------
     # TraceQL fetch: approximate condition pushdown -> candidate traces
@@ -682,10 +1049,12 @@ def _lower_condition(cond, d):
 
     def col_mask(col_name, codes, invert=False):
         def run(blk, rg):
-            c = blk.read_columns(rg, [col_name])[col_name]
             if codes is None:  # negated op with nothing to exclude
                 return np.ones(rg.n_spans, bool)
-            return np.isin(c, codes, invert=invert)
+            # run/dictionary-space when the page allows: unselected runs
+            # are never expanded (column_in_set_mask falls back to the
+            # decoded column bit-identically)
+            return blk.column_in_set_mask(rg, col_name, codes, invert=invert)
 
         if not invert and codes is not None:
             run.prune = lambda rg: not _stats_admit(rg, col_name, codes)
@@ -837,17 +1206,50 @@ def _string_codes(d, op, val):
 def attr_predicate_mask(blk, rg, preds) -> np.ndarray:
     """AND of the attr-table predicates as a span mask — shared by the
     single-block scan and the mesh searcher so the two paths cannot
-    drift."""
+    drift.
+
+    Attr-table columns evaluate in encoded space when their pages
+    allow: key/vtype/value tests are run- or dictionary-space masks and
+    only the MATCHING attr rows' owner spans gather out of attr_span —
+    on a selective attr predicate the table is never expanded. Columns
+    whose pages are NOT encoded fetch together in ONE coalesced ranged
+    read (the PR-3 IO economy), never one read per column."""
     n = rg.n_spans
     mask = np.ones(n, bool)
     if not preds["attr"]:
         return mask
-    attrs = blk.read_columns(rg, ["attr_span", "attr_key", "attr_vtype", "attr_str"])
-    is_str = attrs["attr_vtype"] == VT_STR
+    table_cols = ("attr_span", "attr_key", "attr_vtype", "attr_str")
+    encs = {c: blk.encoded_column(rg, c) for c in table_cols}
+    plain = [c for c in table_cols if encs[c] is None]
+    attrs = blk.read_columns(rg, plain) if plain else {}
+
+    def in_set(col, codes):
+        enc = encs[col]
+        if enc is not None:
+            m = enc.in_set_mask(codes)
+            if m is not None:
+                return m
+        c = attrs.get(col)
+        if c is None:
+            c = blk.read_columns(rg, [col])[col]
+            attrs[col] = c
+        return np.isin(c, codes)
+
+    is_str = in_set("attr_vtype", np.array([VT_STR], np.uint8))
     for key_code, val_codes in preds["attr"]:
-        arow = (attrs["attr_key"] == key_code) & is_str & np.isin(attrs["attr_str"], val_codes)
+        arow = (
+            in_set("attr_key", np.array([key_code], np.uint32))
+            & is_str
+            & in_set("attr_str", val_codes)
+        )
         ok_spans = np.zeros(n, bool)
-        ok_spans[attrs["attr_span"][arow]] = True
+        rows = np.flatnonzero(arow)
+        if len(rows):
+            if encs["attr_span"] is not None:
+                owners = encs["attr_span"].gather(rows)
+            else:
+                owners = attrs["attr_span"][rows]
+            ok_spans[owners] = True
         mask &= ok_spans
     return mask
 

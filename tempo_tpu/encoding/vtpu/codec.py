@@ -28,8 +28,11 @@ import numpy as np
 
 from tempo_tpu import native
 
-CODECS = ("none", "zlib", "zstd", "zstd_shuffle")
+CODECS = ("none", "zlib", "zstd", "zstd_shuffle", "rle", "dbp", "dct")
 DEFAULT_CODEC = "zstd_shuffle"
+# the lightweight, device-decodable tier (encoding/vtpu/lightweight.py):
+# chosen per column at write time, evaluable without row expansion
+LIGHTWEIGHT_CODECS = ("rle", "dbp", "dct")
 
 
 class CorruptPage(Exception):
@@ -101,8 +104,24 @@ def resolve_codec(codec: str) -> str:
     return best_codec() if codec == "auto" else codec
 
 
+def choose_codec(name: str, arr: np.ndarray, codec: str) -> str:
+    """Per-column codec choice: the lightweight tier when the data's
+    run/delta structure earns it, else the resolved default. The chosen
+    codec lands in PageMeta, so readers never guess."""
+    from tempo_tpu.encoding.vtpu import lightweight
+
+    return lightweight.choose_codec(name, arr, resolve_codec(codec))
+
+
 def encode(arr: np.ndarray, codec: str) -> tuple[bytes, int]:
     """array -> (page bytes, crc32 of uncompressed payload)."""
+    if codec in LIGHTWEIGHT_CODECS:
+        from tempo_tpu.encoding.vtpu import lightweight
+
+        raw_crc = zlib.crc32(np.ascontiguousarray(arr).tobytes())
+        enc = {"rle": lightweight.rle_encode, "dbp": lightweight.dbp_encode,
+               "dct": lightweight.dct_encode}[codec]
+        return enc(arr), raw_crc
     nat = native.lib()
     if nat is not None:
         if codec not in nat.PAGE_CODECS:
@@ -120,6 +139,15 @@ def encode(arr: np.ndarray, codec: str) -> tuple[bytes, int]:
 
 
 def decode(page: bytes, dtype: str, shape: tuple, codec: str, crc: int | None = None) -> np.ndarray:
+    if codec in LIGHTWEIGHT_CODECS:
+        from tempo_tpu.encoding.vtpu import lightweight
+
+        dec = {"rle": lightweight.rle_decode, "dbp": lightweight.dbp_decode,
+               "dct": lightweight.dct_decode}[codec]
+        arr = dec(page, dtype, shape)
+        if crc is not None and zlib.crc32(np.ascontiguousarray(arr).tobytes()) != crc:
+            raise CorruptPage(f"crc mismatch for page ({len(page)} bytes, codec={codec})")
+        return arr
     nat = native.lib()
     if nat is not None:
         if codec not in nat.PAGE_CODECS:

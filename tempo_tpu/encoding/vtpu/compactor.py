@@ -359,6 +359,11 @@ class VtpuCompactor:
             rg, raw_pages, reencode,
             min_id=fmt.id_to_hex(tid[0]), max_id=fmt.id_to_hex(tid[-1]),
             n_traces=len(firsts),
+            # the guard already decoded the ID column: offer it for the
+            # lightweight-codec upgrade (legacy blocks gain rle trace_id
+            # — and with it run-space trace segmentation — on their
+            # first compaction, at zero extra decode)
+            decoded={"trace_id": tid},
         )
         return None
 

@@ -250,7 +250,7 @@ class BlockWriter:
 
     def append_relocated(self, rg: fmt.RowGroupMeta, raw_pages: dict,
                          reencode: dict, min_id: str, max_id: str,
-                         n_traces: int) -> None:
+                         n_traces: int, decoded: dict | None = None) -> None:
         """Relocate one input row group: copy its compressed pages
         verbatim — per-page crc/dtype/shape/codec preserved, nothing
         recomputed but the page-index offsets — re-encoding only the
@@ -268,11 +268,22 @@ class BlockWriter:
         the input stats when present, else decode from the page bytes
         already in hand (legacy stats-less inputs gain zone maps on
         their first compaction; no extra backend read either way).
+
+        Lightweight-encoding upgrade, same economics as the zone-map
+        back-fill: columns whose arrays are ALREADY decoded — remapped
+        columns, stats back-fills, and `decoded` (arrays the caller paid
+        for anyway, e.g. the relocation guard's trace-ID column) — are
+        re-encoded when the write-time chooser picks a lightweight codec
+        their current page lacks. Pages that are not in hand decoded
+        stay verbatim: the zero-decode fast path never decodes a page
+        just to change its codec.
         """
         from tempo_tpu.encoding.vtpu import codec as codec_mod
 
+        reencode = dict(reencode)
         stat_arrays: dict = {}
         copied_stats: dict = {}
+        upgradable: dict = dict(decoded or {})
         for name in fmt.STATS_NUMERIC + fmt.STATS_CODES:
             if name not in rg.pages:
                 continue
@@ -283,21 +294,49 @@ class BlockWriter:
                 copied_stats[name] = rg.stats[name]
             else:
                 stat_arrays[name] = fmt.decode_page(raw_pages[name], rg.pages[name])
+                upgradable[name] = stat_arrays[name]
+        if rg.stats.get("root_first"):
+            # sound to copy: relocation preserves row order and neither
+            # the trace grouping nor the (non-dictionary) parent ids
+            # change under a remap
+            copied_stats["root_first"] = True
+        elif not rg.stats:
+            # fully-legacy input (no stats at all): back-fill root_first
+            # from the pages in hand, like every other stat — the ID
+            # column is usually already decoded (the relocation guard),
+            # only the parent page pays a one-time decode here
+            tid = upgradable.get("trace_id")
+            if tid is None and "trace_id" in rg.pages:
+                tid = fmt.decode_page(raw_pages["trace_id"], rg.pages["trace_id"])
+            if tid is not None and "parent_span_id" in rg.pages:
+                stat_arrays["trace_id"] = tid
+                stat_arrays["parent_span_id"] = fmt.decode_page(
+                    raw_pages["parent_span_id"], rg.pages["parent_span_id"])
         stats = {**fmt.compute_stats(stat_arrays), **copied_stats}
 
-        out_codec = None
+        chosen_codecs: dict[str, str] = {}
+        for name, arr in upgradable.items():
+            if name in reencode or name not in rg.pages:
+                continue
+            if rg.pages[name].codec in codec_mod.LIGHTWEIGHT_CODECS:
+                continue  # already on the lightweight tier: copy verbatim
+            chosen = codec_mod.choose_codec(name, arr, self.cfg.codec)
+            if chosen in codec_mod.LIGHTWEIGHT_CODECS:
+                reencode[name] = arr
+                chosen_codecs[name] = chosen  # don't re-run the probe below
+
         payload = bytearray()
         pages: dict[str, fmt.PageMeta] = {}
         for name, pm in rg.pages.items():
             arr = reencode.get(name)
             if arr is not None:
-                if out_codec is None:
-                    out_codec = codec_mod.resolve_codec(self.cfg.codec)
-                page, crc = codec_mod.encode(arr, out_codec)
+                chosen = chosen_codecs.get(name) or codec_mod.choose_codec(
+                    name, arr, self.cfg.codec)
+                page, crc = codec_mod.encode(arr, chosen)
                 pages[name] = fmt.PageMeta(
                     offset=self.offset + len(payload), length=len(page),
                     dtype=arr.dtype.str, shape=tuple(arr.shape),
-                    codec=out_codec, crc=crc,
+                    codec=chosen, crc=crc,
                 )
                 self.pages_reencoded += 1
                 self.bytes_reencoded += len(page)

@@ -85,6 +85,24 @@ def compute_stats(cols: dict) -> dict:
             codes = np.unique(arr)
             if len(codes) <= MAX_STAT_CODES:
                 out[name] = [int(c) for c in codes]
+    # root_first: root resolution degenerates to "first row of the
+    # trace" for EVERY trace of this row group — either the first row
+    # IS a root (parent id zero) or the trace has no root row at all
+    # (both cases resolve to the first-row fallback). The run-space hit
+    # collector then finds root rows with zero parent-column reads;
+    # false/absent falls back to the parent scan. Recorded only when
+    # true (absence = unknown, like all stats).
+    tid = cols.get("trace_id")
+    par = cols.get("parent_span_id")
+    if tid is not None and par is not None and len(tid):
+        new = np.ones(len(tid), bool)
+        new[1:] = (tid[1:] != tid[:-1]).any(axis=1)
+        is_root = (par == 0).all(axis=1)
+        seg = np.cumsum(new) - 1
+        has_root = np.zeros(int(seg[-1]) + 1, bool)
+        np.logical_or.at(has_root, seg[is_root], True)
+        if bool((~has_root | is_root[new]).all()):
+            out["root_first"] = True
     return out
 
 
@@ -178,17 +196,25 @@ def serialize_row_group(batch: SpanBatch, lo: int, hi: int, base_offset: int,
         cols.append((name, arr))
 
     # column pages compress in parallel on the codec pool (the native
-    # codec releases the GIL), then assemble in deterministic order
-    encoded = codec_mod.map_pages(lambda c: codec_mod.encode(c[1], codec), cols)
+    # codec releases the GIL), then assemble in deterministic order.
+    # Each column picks its own codec: the lightweight tier (rle/dbp)
+    # when the data's run/delta structure earns it, else `codec`.
+    def enc_one(c):
+        name, arr = c
+        chosen = codec_mod.choose_codec(name, arr, codec)
+        page, crc = codec_mod.encode(arr, chosen)
+        return page, crc, chosen
+
+    encoded = codec_mod.map_pages(enc_one, cols)
     payload = bytearray()
     pages: dict[str, PageMeta] = {}
-    for (name, arr), (page, crc) in zip(cols, encoded):
+    for (name, arr), (page, crc, chosen) in zip(cols, encoded):
         pages[name] = PageMeta(
             offset=base_offset + len(payload),
             length=len(page),
             dtype=arr.dtype.str,
             shape=tuple(arr.shape),
-            codec=codec,
+            codec=chosen,
             crc=crc,
         )
         payload.extend(page)
@@ -276,8 +302,16 @@ def plan_page_runs(rg: RowGroupMeta, names, max_gap: int = COALESCE_MAX_GAP):
     column subset are separated only by the unneeded columns between
     them; runs whose gaps stay under max_gap merge into one ranged read.
     Returns [(lo, hi, [name, ...]), ...] sorted by offset.
+
+    Run-building REQUIRES offset order, which neither `names` nor the
+    rg.pages dict guarantees (relocation/reencode mixes interleave the
+    page layout vs the schema order) — so pages are explicitly sorted by
+    offset here, never by dict iteration order.
     """
-    spans = sorted((rg.pages[n].offset, rg.pages[n].length, n) for n in names)
+    spans = sorted(
+        ((rg.pages[n].offset, rg.pages[n].length, n) for n in names),
+        key=lambda s: (s[0], s[1]),
+    )
     runs: list = []
     for off, ln, name in spans:
         if runs and off - runs[-1][1] <= max_gap:

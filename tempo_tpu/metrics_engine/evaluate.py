@@ -25,13 +25,13 @@ from __future__ import annotations
 import numpy as np
 
 from tempo_tpu.metrics_engine.plan import MetricsPlan
-from tempo_tpu.model.columnar import ATTR_COLUMNS
 from tempo_tpu.ops.sketch import np_hist_quantile
 
 
 def new_stats() -> dict:
     return {
         "inspectedBytes": 0,
+        "decodedBytes": 0,
         "inspectedBlocks": 0,
         "inspectedSpans": 0,
         "prunedRowGroups": 0,
@@ -85,10 +85,16 @@ def _format_group_value(kind, v, d) -> str:
     return str(int(f)) if f.is_integer() else repr(f)
 
 
-def eval_batch(plan: MetricsPlan, batch, dictionary, series: SeriesTable) -> EvalResult:
+def eval_batch(plan: MetricsPlan, batch, dictionary, series: SeriesTable,
+               premask: np.ndarray | None = None) -> EvalResult:
     """One row group (ColumnView) or WAL segment (SpanBatch) -> combined
     slot ids. Exact: filters/fields evaluate on the vectorized TraceQL
-    path, identical to what search would match."""
+    path, identical to what search would match.
+
+    premask: the filter-stage mask already computed in encoded (run/
+    dictionary) space — vector.encoded_filter_mask guarantees it equals
+    what the stages below would produce, so the filter columns are never
+    expanded to rows."""
     from tempo_tpu.traceql import vector
 
     n = batch.num_spans
@@ -97,9 +103,10 @@ def eval_batch(plan: MetricsPlan, batch, dictionary, series: SeriesTable) -> Eva
         return empty
     ctx = vector._Ctx(batch=batch, d=dictionary, n=n)
 
-    mask = None
-    for st in plan.filters:
-        mask = vector._spanset_mask(st, ctx, base=mask)
+    mask = premask
+    if mask is None:
+        for st in plan.filters:
+            mask = vector._spanset_mask(st, ctx, base=mask)
     if mask is None:
         mask = np.ones(n, bool)
 
@@ -238,10 +245,14 @@ class HostAccumulator:
 
 
 class DeviceAccumulator(HostAccumulator):
-    """Single-device reduction: slot batches buffer host-side, then one
-    Pallas segmented-bincount dispatch folds many row groups at once
-    (per-row-group dispatches lose 600:1 through the dispatch tunnel —
-    the same economics as the search path, PERF.md)."""
+    """Single-device reduction: slot batches buffer host-side RUN
+    COMPRESSED (spans of one trace share series and usually time bin,
+    so consecutive slot ids repeat — compress_slot_runs collapses them
+    to (slot, weight) pairs), then one segmented-bincount dispatch
+    folds many row groups at once (per-row-group dispatches lose 600:1
+    through the dispatch tunnel — the same economics as the search
+    path, PERF.md). The device consumes the run form directly: smaller
+    H2D, weighted adds, identical counts."""
 
     def __init__(self, plan: MetricsPlan, series: SeriesTable | None = None,
                  flush_rows: int = 1 << 20):
@@ -252,10 +263,13 @@ class DeviceAccumulator(HostAccumulator):
         self.dispatches = 0
 
     def add(self, res: EvalResult, batch=None) -> None:
-        live = res.slots[res.slots >= 0]
-        if len(live):
-            self._buf.append(live.astype(np.int32))
-            self._buf_rows += len(live)
+        # per-row-group cost is ONE list append: masking, run
+        # compression and the fold all happen once per flush over the
+        # concatenated stream (the dispatch already drops negative
+        # slots, so nothing needs per-batch cleanup)
+        if len(res.slots):
+            self._buf.append(res.slots)
+            self._buf_rows += len(res.slots)
         self.observe_exemplars(res, batch)
         if self._buf_rows >= self.flush_rows:
             self.flush()
@@ -263,11 +277,12 @@ class DeviceAccumulator(HostAccumulator):
     def flush(self) -> None:
         if not self._buf:
             return
-        from tempo_tpu.ops.pallas_kernels import seg_bincount
+        from tempo_tpu.ops.pallas_kernels import compress_slot_runs, seg_bincount
 
-        slots = np.concatenate(self._buf)
+        raw = self._buf[0] if len(self._buf) == 1 else np.concatenate(self._buf)
         self._buf, self._buf_rows = [], 0
-        self.counts += seg_bincount(slots, self.plan.n_slots)
+        slots, weights = compress_slot_runs(raw)
+        self.counts += seg_bincount(slots, self.plan.n_slots, weights=weights)
         self.dispatches += 1
 
     def merged_counts(self) -> np.ndarray:
@@ -337,12 +352,43 @@ def rg_prunes(plan: MetricsPlan, rg, resolvers, all_conditions: bool) -> bool:
     return bool(hooks) and len(hooks) == len(resolvers) and all(hooks)
 
 
+def rg_eval_view(plan: MetricsPlan, blk, rg, d):
+    """(view, premask, dead) for one surviving row group: the filter
+    stages are tried in ENCODED space first (vector.encoded_filter_mask
+    over the row group's rle/dct pages — filter columns never expand);
+    a dead premask means nothing in the group can match and NO column
+    needs decoding at all. The view is lazy either way, so the rest of
+    evaluation (bins, by(), value exprs) decodes exactly the columns it
+    touches. Shared by the host and mesh paths so they cannot drift."""
+    from tempo_tpu.traceql import vector
+
+    from tempo_tpu.model.columnar import ATTR_COLUMNS, _empty_cols
+
+    enc_of = (lambda name: blk.encoded_column(rg, name))
+    premask = vector.encoded_filter_mask(plan.filters, enc_of, d, rg.n_spans)
+    if premask is not None and not premask.any():
+        return None, premask, True
+    if premask is None:
+        # filters need row space anyway: keep the ONE coalesced
+        # projection read (gap-tolerant ranged IO, PR 3) instead of a
+        # round trip per touched column
+        cols = blk.read_columns(rg, list(plan.span_cols))
+        attrs = (blk.read_columns(rg, list(ATTR_COLUMNS))
+                 if plan.needs_attrs else _empty_cols(ATTR_COLUMNS))
+        return vector.ColumnView(cols, attrs, rg.n_spans), None, False
+    view = vector.LazyColumnView(
+        lambda name, b=blk, r=rg: b.read_columns(r, [name])[name],
+        lambda name, b=blk, r=rg: b.read_columns(r, [name])[name],
+        rg.n_spans,
+        enc_of=enc_of,
+    )
+    return view, premask, False
+
+
 def evaluate_block(plan: MetricsPlan, blk, acc) -> None:
     """Fold one backend block into the accumulator, zone-map pruned and
     projection-limited like the search read path."""
     from tempo_tpu.encoding.vtpu.block import pruned_row_groups_total, zone_maps_enabled
-    from tempo_tpu.model.columnar import _empty_cols
-    from tempo_tpu.traceql import vector
 
     d = blk.dictionary()
     resolvers, impossible = _lower_prunes(plan, d)
@@ -358,15 +404,11 @@ def evaluate_block(plan: MetricsPlan, blk, acc) -> None:
             blk.pruned_row_groups += 1
             pruned_row_groups_total.inc()
             continue
-        cols = blk.read_columns(rg, list(plan.span_cols))
-        attrs = (
-            blk.read_columns(rg, list(ATTR_COLUMNS))
-            if plan.needs_attrs
-            else _empty_cols(ATTR_COLUMNS)
-        )
-        view = vector.ColumnView(cols, attrs, rg.n_spans)
+        view, premask, dead = rg_eval_view(plan, blk, rg, d)
         acc.stats["inspectedSpans"] += rg.n_spans
-        acc.add(eval_batch(plan, view, d, acc.series), view)
+        if dead:
+            continue  # run-space veto: zero columns expanded
+        acc.add(eval_batch(plan, view, d, acc.series, premask=premask), view)
 
 
 # ---------------------------------------------------------------------------

@@ -235,6 +235,7 @@ class Querier:
                 sub.stats["inspectedBlocks"] += 1
                 evaluate_block(plan, blk, sub)
                 sub.stats["inspectedBytes"] += blk.bytes_read
+                sub.stats["decodedBytes"] += getattr(blk, "decoded_bytes", 0)
 
             try:
                 self.db.guard_block(tenant, m.block_id, run)

@@ -191,11 +191,13 @@ _BC_MAX_SLOTS = 1 << 15  # widest slot vector the VMEM one-hot tile carries
 # (256 x 32768 f32 = 32 MiB streamed tile-by-tile; wider falls back to host)
 
 
-def _bincount_kernel(slots_ref, out_ref):
+def _bincount_kernel(slots_ref, w_ref, out_ref):
     """Accumulate one row tile into the slot counts.
 
     slots_ref: (_BC_ROWS, 1) int32 in VMEM — combined slot index per
     span row ((series*bins + bin) [*buckets + bucket]); negative = drop.
+    w_ref: (_BC_ROWS, 1) f32 — per-entry weight (1 for raw rows; the
+    run length for run-compressed slot streams).
     out_ref: (1, S) f32 — running counts, same block every grid step
     (the TPU grid is sequential, so += accumulation is well-defined).
 
@@ -203,6 +205,8 @@ def _bincount_kernel(slots_ref, out_ref):
     a lane iota to build the (rows, S) one-hot tile, and a (1, rows) x
     (rows, S) dot folds it — scatter-free, which is the shape the MXU
     wants (SQL-on-compressed-data aggregates reduce the same way).
+    Weighted entries just scale the reducing vector: the matmul does
+    the multiply-by-run-length for free.
     """
     i = pl.program_id(0)
 
@@ -214,15 +218,17 @@ def _bincount_kernel(slots_ref, out_ref):
     S = out_ref.shape[1]
     iota = jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
     one_hot = (slots == iota).astype(jnp.float32)  # (R, S); negatives match nothing
-    ones = jnp.ones((1, slots.shape[0]), jnp.float32)
+    w = w_ref[...].reshape(1, slots.shape[0])
     out_ref[...] += jax.lax.dot_general(
-        ones, one_hot, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        w, one_hot, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
 
 
 @functools.partial(jax.jit, static_argnames=("n_slots_pad", "interpret"))
-def _bincount_call(slots: jnp.ndarray, n_slots_pad: int, interpret: bool):
-    """slots: (N,) int32, N a multiple of _BC_ROWS -> (n_slots_pad,) f32."""
+def _bincount_call(slots: jnp.ndarray, weights: jnp.ndarray, n_slots_pad: int,
+                   interpret: bool):
+    """slots/weights: (N,) int32, N a multiple of _BC_ROWS ->
+    (n_slots_pad,) f32."""
     N = slots.shape[0]
     out = pl.pallas_call(
         _bincount_kernel,
@@ -230,15 +236,58 @@ def _bincount_call(slots: jnp.ndarray, n_slots_pad: int, interpret: bool):
         grid=(N // _BC_ROWS,),
         in_specs=[
             pl.BlockSpec((_BC_ROWS, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_BC_ROWS, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, n_slots_pad), lambda i: (0, 0),
                                memory_space=pltpu.VMEM),
         interpret=interpret,
-    )(slots.reshape(N, 1))
+    )(slots.reshape(N, 1), weights.astype(jnp.float32).reshape(N, 1))
     return out.reshape(n_slots_pad)
 
 
-def seg_bincount(slots: np.ndarray, n_slots: int) -> np.ndarray:
+@functools.partial(jax.jit, static_argnames=("n_slots_pad",))
+def _bincount_xla(slots: jnp.ndarray, weights: jnp.ndarray, n_slots_pad: int):
+    """Compiled scatter-add bincount — the device reduction on compiled
+    non-TPU backends (GPU), where Mosaic kernels can't build but scatter
+    is native. Integer adds: bit-identical to every other home."""
+    idx = jnp.where(slots >= 0, slots, n_slots_pad)  # OOB + drop mode
+    return jnp.zeros(n_slots_pad, jnp.int32).at[idx].add(weights, mode="drop")
+
+
+def compress_slot_runs(slots: np.ndarray, max_fraction: float = 0.75):
+    """Run-compress a slot stream: consecutive equal slot ids (spans of
+    one trace share series and usually time bin) collapse to one
+    (slot, weight) pair — the reduction then consumes the run form,
+    shrinking both the H2D transfer and the scatter width. Exact: the
+    weighted counts sum to precisely the per-row counts.
+
+    Streams that barely compress (every span in its own bucket — the
+    quantile shape) return (slots_i32, None): shipping raw beats paying
+    for weights that are all 1. max_fraction is the runs/rows ratio
+    above which compression is declined."""
+    n = len(slots)
+    if n == 0:
+        return slots.astype(np.int32), np.zeros(0, np.int32)
+    if n > 512:
+        # cheap prefix probe before paying the full boundary pass: a
+        # stream whose first 256 entries barely repeat won't compress
+        head = int(np.count_nonzero(slots[1:257] != slots[:256]))
+        if head > 256 * max_fraction:
+            return slots, None
+    new = np.ones(n, bool)
+    new[1:] = slots[1:] != slots[:-1]
+    r = int(np.count_nonzero(new))
+    if r > n * max_fraction:
+        # no copy on decline: the raw stream ships as-is (the i32 cast
+        # only pays for itself when there is an H2D transfer to shrink)
+        return slots, None
+    firsts = np.flatnonzero(new)
+    weights = np.diff(np.append(firsts, n)).astype(np.int32)
+    return slots[firsts].astype(np.int32), weights
+
+
+def seg_bincount(slots: np.ndarray, n_slots: int,
+                 weights: np.ndarray | None = None) -> np.ndarray:
     """Count occurrences of each slot id in [0, n_slots): the device
     reduction behind `| rate()` / `| quantile_over_time()` — span rows
     carry a combined (series, time-bin[, histogram-bucket]) slot index
@@ -246,34 +295,217 @@ def seg_bincount(slots: np.ndarray, n_slots: int) -> np.ndarray:
     addition, so mesh shards psum it). Negative slot ids are dropped
     (masked spans / out-of-window bins). Returns (n_slots,) int64.
 
-    Counts are exact below 2**24 per slot (f32 accumulation of unit
-    increments); one dispatch covers at most a few million spans, far
-    inside that bound.
+    weights: optional per-slot-entry counts (the run-compressed form
+    from compress_slot_runs) — the MXU one-hot matmul folds them by
+    scaling the reducing vector, the XLA path scatter-adds them.
+
+    Reduction home by backend: the Pallas one-hot-matmul kernel on real
+    TPUs, a compiled XLA scatter-add on other COMPILED accelerator
+    backends (GPU), and the numpy fold when only a CPU is attached —
+    interpret-mode pallas is an interpreter, not a device path (it lost
+    3.7x to host numpy on the unselective quantile), and XLA-CPU's
+    serial scatter loses ~25x to np.bincount, so on a CPU host the
+    device road's win is the ARCHITECTURE (batched buffering + run
+    compression + one fold), not the fold's instruction set.
+    TEMPO_TPU_NO_PALLAS=1 also forces the numpy fold. Counts are exact
+    below 2**24 per slot (f32 accumulation); one dispatch covers at
+    most a few million spans, far inside that bound.
     """
     n = slots.shape[0]
     if n == 0:
         # a zero-step grid never runs _init, leaving out_ref undefined
         return np.zeros(n_slots, np.int64)
-    n_pad = ((n + _BC_ROWS - 1) // _BC_ROWS) * _BC_ROWS
-    padded = np.full(n_pad, -1, np.int32)
-    padded[:n] = slots.astype(np.int32)
     s_pad = 128
     while s_pad < n_slots:
         s_pad <<= 1  # pow2 widths bound the jit cache
-    if s_pad > _BC_MAX_SLOTS:
-        # the one-hot tile is (_BC_ROWS, s_pad) f32 in VMEM; past this
-        # width it stops fitting (and the MXU win is gone anyway —
-        # giant sparse slot spaces are bincount-bound, not matmul-bound)
-        return np.bincount(padded[padded >= 0], minlength=n_slots).astype(np.int64)[:n_slots]
-    if not _use_pallas():
-        # negative ids would wrap under jnp indexing; the exact host
-        # mirror is a masked bincount
-        out = np.bincount(padded[padded >= 0], minlength=s_pad).astype(np.int64)
-    else:
+
+    def w_np():
+        return (np.ones(n, np.int32) if weights is None
+                else np.asarray(weights, np.int32))
+
+    on_tpu = _use_pallas() and not _interpret()
+    if on_tpu and s_pad <= _BC_MAX_SLOTS:
+        n_pad = ((n + _BC_ROWS - 1) // _BC_ROWS) * _BC_ROWS
+        padded = np.full(n_pad, -1, np.int32)
+        padded[:n] = slots.astype(np.int32)
+        w_pad = np.zeros(n_pad, np.int32)
+        w_pad[:n] = w_np()
         out = np.asarray(
-            _bincount_call(jnp.asarray(padded), s_pad, _interpret())
+            _bincount_call(jnp.asarray(padded), jnp.asarray(w_pad), s_pad, False)
         ).astype(np.int64)
-    return out[:n_slots]
+        return out[:n_slots]
+    if _use_pallas() and jax.default_backend() not in ("cpu",) :
+        # compiled accelerator without Mosaic (or a slot space too wide
+        # for the VMEM one-hot tile): native scatter-add
+        out = np.asarray(_bincount_xla(
+            jnp.asarray(slots.astype(np.int32)), jnp.asarray(w_np()), s_pad
+        )).astype(np.int64)
+        return out[:n_slots]
+    # CPU-only (or pallas disabled): the exact numpy mirror — negative
+    # ids would wrap under jnp indexing; mask then integer scatter-add
+    # (np.add.at stays in int64, no float64 weighted-bincount detour)
+    live = slots >= 0
+    out = np.zeros(n_slots, np.int64)
+    if weights is None:
+        out[:] = np.bincount(slots[live], minlength=n_slots)[:n_slots]
+    else:
+        np.add.at(out, slots[live], np.asarray(weights, np.int64)[live])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# device decode of the lightweight page encodings (zero-decode read path)
+# ---------------------------------------------------------------------------
+#
+# The lightweight tier (encoding/vtpu/lightweight.py) exists so pages
+# can travel to the compute unit STILL ENCODED and decode next to the
+# predicate math instead of on the host codec: rle expansion is one
+# repeat, dbp is bit-window extraction + a two-limb prefix scan, and
+# the byte-shuffle transform inverts as shifts+ors. Everything here is
+# one jitted program per shape — compiled by XLA on whatever backend is
+# attached, fused with the predicate compare that follows (pallas
+# interpret mode is an interpreter, not a device path; see seg_bincount).
+# u64 values ride as (hi, lo) u32 limb pairs (no x64 on device); the
+# limb adder below is EXACT u64 addition, so device decode is
+# bit-identical to the host cumsum.
+
+
+def _limb_add(a, b):
+    """(hi, lo) + (hi, lo) mod 2^64 — associative (it IS u64 addition),
+    so lax.associative_scan turns delta streams into absolute values."""
+    ah, al = a
+    bh, bl = b
+    lo = al + bl
+    carry = (lo < bl).astype(jnp.uint32)
+    return ah + bh + carry, lo
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _dbp_decode_jit(words: jnp.ndarray, first_hi, first_lo, width, n: int):
+    """Packed zigzag deltas -> (hi, lo) absolute values, one sub-column.
+
+    words: (W,) uint32 — the packed stream as little-endian u32 words
+    (padded with one extra word). width: traced scalar <= 32, so every
+    value spans at most two words: two gathers + shifts extract it.
+    """
+    w = width.astype(jnp.uint32)
+    i = jnp.arange(n - 1, dtype=jnp.int32)
+    off = i.astype(jnp.uint32) * w
+    word_i = (off >> 5).astype(jnp.int32)
+    rem = off & jnp.uint32(31)
+    lo_w = words[word_i]
+    hi_w = words[word_i + 1]
+    # shift counts stay < 32 ((32-rem)&31 with the rem==0 case masked
+    # out by the where) — no UB shifts on any backend
+    hi_part = jnp.where(rem == 0, jnp.uint32(0),
+                        hi_w << ((jnp.uint32(32) - rem) & jnp.uint32(31)))
+    mask = jnp.where(w >= 32, jnp.uint32(0xFFFFFFFF),
+                     (jnp.uint32(1) << (w & jnp.uint32(31))) - jnp.uint32(1))
+    z = ((lo_w >> rem) | hi_part) & mask
+    # unzigzag in 32-bit two's complement, sign-extended to limbs —
+    # equal to the host's u64 unzigzag because |delta| < 2^31 (w <= 32)
+    d = (z >> jnp.uint32(1)) ^ (jnp.uint32(0) - (z & jnp.uint32(1)))
+    dh = jnp.where((d >> jnp.uint32(31)) != 0, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+    hs = jnp.concatenate([first_hi.reshape(1), dh])
+    ls = jnp.concatenate([first_lo.reshape(1), d])
+    return jax.lax.associative_scan(_limb_add, (hs, ls))
+
+
+def dbp_decode_device(page: bytes, dtype: str, shape: tuple) -> np.ndarray:
+    """Decode one dbp page ON DEVICE (the host only reinterprets the
+    packed bytes as u32 words — no codec work). Bit-identical to
+    lightweight.dbp_decode; the jit below is what the fused mesh scan
+    inlines next to its predicate compare."""
+    from tempo_tpu.encoding.vtpu import lightweight as lw
+
+    first, _anchors, widths, streams, n = lw.dbp_parts(page, dtype, shape)
+    dt = np.dtype(dtype)
+    if n == 0:
+        return np.empty(shape, dt)
+    k = len(widths)
+    out = np.empty((n, k), np.uint64)
+    for c in range(k):
+        raw = bytes(streams[c])
+        pad = (-len(raw)) % 4 + 4  # round to words + one guard word
+        words = np.frombuffer(raw + b"\x00" * pad, "<u4")
+        hi, lo = _dbp_decode_jit(
+            jnp.asarray(words),
+            jnp.uint32(first[c] >> np.uint64(32)),
+            jnp.uint32(first[c] & np.uint64(0xFFFFFFFF)),
+            jnp.int32(widths[c]),
+            n,
+        )
+        out[:, c] = (np.asarray(hi).astype(np.uint64) << np.uint64(32)) | np.asarray(lo)
+    return np.ascontiguousarray(out.astype(dt, copy=False).reshape(shape))
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def rle_expand_device(values: jnp.ndarray, lengths: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Run values + lengths -> (n,) rows: RLE expansion is native on
+    device (one repeat — a cumsum + gather under the hood)."""
+    return jnp.repeat(values, lengths, total_repeat_length=n)
+
+
+@functools.partial(jax.jit, static_argnames=("itemsize",))
+def unshuffle_device(planes: jnp.ndarray, itemsize: int) -> jnp.ndarray:
+    """Invert the blosc-style byte shuffle on device: planes (itemsize,
+    N) uint8 — plane j holds byte j of every element — recombine as
+    shifts+ors into (N,) uint32/uint64-as-limbs. itemsize <= 4 returns
+    uint32. The host then only pays the entropy decode (zstd), and the
+    transpose that used to follow it lands next to the predicate math."""
+    out = jnp.zeros(planes.shape[1], jnp.uint32)
+    for j in range(min(itemsize, 4)):
+        out = out | (planes[j].astype(jnp.uint32) << jnp.uint32(8 * j))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fused RLE decode + predicate scan (batched across row-group units)
+# ---------------------------------------------------------------------------
+
+
+def rle_cols_hit(values: jnp.ndarray, lengths: jnp.ndarray,
+                 codes: jnp.ndarray, n: int, hit: jnp.ndarray) -> jnp.ndarray:
+    """ONE unit's fused RLE decode+predicate: values/lengths (C, R),
+    codes (C, K) — the in-set verdict is computed per RUN, expanded
+    with one repeat, and AND-folded into `hit` (n,). The single shared
+    body behind fused_rle_in_set and the mesh's make_sharded_rle_scan,
+    so the two fused-scan homes cannot drift."""
+    C, K = codes.shape
+    for c in range(C):
+        run_hit = jnp.zeros(values.shape[1], bool)
+        for k in range(K):
+            code = codes[c, k]
+            run_hit = run_hit | ((values[c] == code)
+                                 & (code != jnp.uint32(0xFFFFFFFF)))
+        hit = hit & jnp.repeat(run_hit, lengths[c], total_repeat_length=n)
+    return hit
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _fused_rle_in_set_jit(values: jnp.ndarray, lengths: jnp.ndarray,
+                          codes: jnp.ndarray, n: int) -> jnp.ndarray:
+    """values/lengths (U, C, R), codes (U, C, K) -> (U, n) bool masks,
+    batched over U (block, row-group) units so the dispatch tax is
+    paid once per batch, not per row group."""
+
+    def unit(v, l, cd):
+        return rle_cols_hit(v, l, cd, n, jnp.ones((n,), bool))
+
+    return jax.vmap(unit)(values, lengths, codes)
+
+
+def fused_rle_in_set(values: np.ndarray, lengths: np.ndarray,
+                     codes: np.ndarray, n: int) -> np.ndarray:
+    """Host wrapper for the fused batched scan (the single-device analog
+    of parallel/search.make_sharded_rle_scan). Rows past a unit's true
+    span count must be masked by the caller's valid mask."""
+    return np.asarray(_fused_rle_in_set_jit(
+        jnp.asarray(values.astype(np.uint32)),
+        jnp.asarray(lengths.astype(np.int32)),
+        jnp.asarray(codes.astype(np.uint32)),
+        n,
+    ))
 
 
 def u64_range_scan(values: np.ndarray, lo_bound: int, hi_bound: int, n_pad: int) -> jnp.ndarray:

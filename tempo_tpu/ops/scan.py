@@ -99,6 +99,57 @@ def find_ids(trace_limbs: jnp.ndarray, target: jnp.ndarray) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# run-space predicate evaluation (host numpy)
+# ---------------------------------------------------------------------------
+#
+# The row-space scans above compare one value per ROW; for RLE pages the
+# same predicates compare one value per RUN — cost proportional to the
+# encoded form, not the row count — and the boolean verdict expands with
+# a single repeat (which is also the shape the device expansion kernel
+# wants, ops/pallas_kernels.rle_expand_device). These are the eq /
+# in_set / between of the zero-decode read path.
+
+
+def in_set_runs(run_values: np.ndarray, codes: np.ndarray,
+                invert: bool = False) -> np.ndarray:
+    """Per-RUN in-set verdict: (n_runs,) bool. Row semantics match
+    np.isin(expanded, codes, invert=...) exactly — every row of a run
+    holds the run's value, so the run verdict IS the row verdict."""
+    return np.isin(run_values, codes, invert=invert)
+
+
+def between_runs(run_values: np.ndarray, lo, hi) -> np.ndarray:
+    """Per-run lo <= v <= hi (inclusive both ends, like `between`)."""
+    v = run_values
+    return (v >= np.asarray(lo, v.dtype)) & (v <= np.asarray(hi, v.dtype))
+
+
+def expand_run_mask(run_mask: np.ndarray, run_lengths: np.ndarray,
+                    n: int) -> np.ndarray:
+    """Run verdicts -> (n,) row mask. A plain repeat: one bool per row,
+    never the VALUES — unselected runs are never expanded."""
+    if len(run_mask) == 0:
+        return np.zeros(n, bool)
+    out = np.repeat(run_mask, run_lengths)
+    assert len(out) == n, (len(out), n)
+    return out
+
+
+def runs_firsts_seg(run_lengths: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(firsts, seg) row segmentation implied by run lengths: firsts[r]
+    = first row of run r, seg[i] = run of row i. For an RLE trace-ID
+    column the runs ARE the traces (trace-sorted rows make equal IDs
+    maximal stretches), so this replaces trace_segmentation without
+    decoding a single ID."""
+    lens = np.asarray(run_lengths, np.int64)
+    firsts = np.zeros(len(lens), np.int64)
+    if len(lens):
+        np.cumsum(lens[:-1], out=firsts[1:])
+    seg = np.repeat(np.arange(len(lens), dtype=np.int64), lens)
+    return firsts, seg
+
+
+# ---------------------------------------------------------------------------
 # host helpers: dictionary-side string predicate resolution
 # ---------------------------------------------------------------------------
 

@@ -36,30 +36,34 @@ log = logging.getLogger(__name__)
 
 @lru_cache(maxsize=32)
 def make_sharded_bincount(mesh, n_slots: int):
-    """Jitted sharded segmented bincount.
+    """Jitted sharded segmented bincount over RUN-COMPRESSED slots.
 
     Inputs (stacked over the (W, R) mesh axes):
-      slots (W, R, N) int32 — combined slot id per span row; -1 = drop
+      slots   (W, R, N) int32 — combined slot id per entry; -1 = drop
+      weights (W, R, N) int32 — rows carried by each entry (1 for raw
+              streams; the run length for compress_slot_runs streams —
+              the device consumes the compressed form directly)
     Returns:
       counts (W, n_slots) int32 — per-window totals, psum-merged over
       the range axis (replicated across range shards post-collective)
     """
 
-    def local(slots):
+    def local(slots, weights):
         idx = jnp.where(slots >= 0, slots, n_slots)  # OOB + drop mode
         counts = jnp.zeros((n_slots,), jnp.int32).at[idx].add(
-            jnp.int32(1), mode="drop"
+            weights, mode="drop"
         )
         return jax.lax.psum(counts, RANGE_AXIS)
 
-    def step(slots):
-        return local(slots[0, 0])[None]
+    def step(slots, weights):
+        return local(slots[0, 0], weights[0, 0])[None]
 
+    spec = P(WINDOW_AXIS, RANGE_AXIS)
     return jax.jit(
         shard_map_compat(
             step,
             mesh=mesh,
-            in_specs=(P(WINDOW_AXIS, RANGE_AXIS),),
+            in_specs=(spec, spec),
             out_specs=P(WINDOW_AXIS),
         )
     )
@@ -97,33 +101,37 @@ class MeshMetricsEvaluator:
         from tempo_tpu.metrics_engine.evaluate import (
             _lower_prunes,
             eval_batch,
+            rg_eval_view,
             rg_prunes,
         )
-        from tempo_tpu.model.columnar import ATTR_COLUMNS, _empty_cols
-        from tempo_tpu.traceql import vector
 
         stats = self.last_stats = {"dispatches": 0, "units": 0, "h2d_bytes": 0}
         zm = zone_maps_enabled()
         all_conds = plan.pipeline.conditions().all_conditions
         cap = self.w * self.r
         scan = make_sharded_bincount(self.mesh, plan.n_slots)
-        pending: list[np.ndarray] = []
+        pending: list = []  # run-compressed (slots, weights) pairs
         opened: list = []
 
         def flush():
             if not pending:
                 return
-            pad = self.bucket_for(max(len(s) for s in pending))
+            pad = self.bucket_for(max(len(s) for s, _ in pending))
             stacked = np.full((cap, pad), -1, np.int32)
-            for i, s in enumerate(pending):
+            wstack = np.zeros((cap, pad), np.int32)
+            for i, (s, w) in enumerate(pending):
                 stacked[i, : len(s)] = s
+                wstack[i, : len(s)] = w if w is not None else 1
             with _dispatch_lock:
-                out = scan(jnp.asarray(stacked.reshape(self.w, self.r, pad)))
+                out = scan(
+                    jnp.asarray(stacked.reshape(self.w, self.r, pad)),
+                    jnp.asarray(wstack.reshape(self.w, self.r, pad)),
+                )
                 counts = np.asarray(out).sum(axis=0, dtype=np.int64)
             acc.counts += counts
             stats["dispatches"] += 1
             stats["units"] += len(pending)
-            stats["h2d_bytes"] += stacked.nbytes
+            stats["h2d_bytes"] += stacked.nbytes + wstack.nbytes
             pending.clear()
 
         from tempo_tpu.backend.base import NotFound
@@ -137,7 +145,7 @@ class MeshMetricsEvaluator:
             # compaction output that replaced it, and a half-committed
             # block would double-count them in a response that carries no
             # partial flag
-            blk_batches: list[np.ndarray] = []
+            blk_batches: list = []  # (slots, weights) pairs
             blk_results: list = []  # (res, view) for exemplars
             blk_spans = 0
             blk_pruned = 0
@@ -157,21 +165,25 @@ class MeshMetricsEvaluator:
                     if zm and resolvers and rg_prunes(plan, rg, resolvers, all_conds):
                         blk_pruned += 1
                         continue
-                    cols = with_retries(
-                        lambda b=blk, r=rg: b.read_columns(r, list(plan.span_cols)))
-                    attrs = (
-                        with_retries(
-                            lambda b=blk, r=rg: b.read_columns(r, list(ATTR_COLUMNS)))
-                        if plan.needs_attrs
-                        else _empty_cols(ATTR_COLUMNS)
-                    )
-                    view = vector.ColumnView(cols, attrs, rg.n_spans)
-                    res = eval_batch(plan, view, d, acc.series)
+                    # encoded-space filters + lazy projection, same
+                    # seam as the host path (filter columns never
+                    # expand; a dead run-space verdict skips the unit)
+                    view, premask, dead = with_retries(
+                        lambda b=blk, r=rg: rg_eval_view(plan, b, r, d))
                     blk_spans += rg.n_spans
+                    if dead:
+                        continue
+                    res = with_retries(
+                        lambda v=view, p=premask: eval_batch(
+                            plan, v, d, acc.series, premask=p))
                     blk_results.append((res, view))
-                    live = res.slots[res.slots >= 0].astype(np.int32)
+                    live = res.slots[res.slots >= 0]
                     if len(live):
-                        blk_batches.append(live)
+                        # run-compressed: the device bincount consumes
+                        # (slot, weight) pairs, not raw rows
+                        from tempo_tpu.ops.pallas_kernels import compress_slot_runs
+
+                        blk_batches.append(compress_slot_runs(live))
             except NotFound as e:  # deleted mid-query: benign, skip whole block
                 log.warning("mesh metrics: block %s deleted mid-query: %s",
                             blk.meta.block_id, e)
@@ -198,3 +210,5 @@ class MeshMetricsEvaluator:
                 on_block_ok(blk.meta.block_id)
         flush()
         acc.stats["inspectedBytes"] += sum(b.bytes_read for b in opened)
+        acc.stats["decodedBytes"] += sum(
+            getattr(b, "decoded_bytes", 0) for b in opened)

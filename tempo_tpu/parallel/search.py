@@ -117,6 +117,48 @@ def make_sharded_bloom_test(mesh, p: bloom.BloomPlan):
 
 
 @lru_cache(maxsize=32)
+def make_sharded_rle_scan(mesh, n_cols: int, max_codes: int, n_pad: int):
+    """Fused RLE decode + in-set scan, sharded over the mesh: the
+    zero-decode device road. Each shard ships its predicate columns as
+    RUNS (values + lengths — the encoded form, a fraction of the row
+    count in H2D bytes); the device computes the in-set verdict per run,
+    expands it with one repeat, ANDs across columns, and psums the hit
+    count — byte-unshuffle/entropy work never happens because the pages
+    never left their lightweight encoding.
+
+    Inputs (stacked over the (W, R) mesh axes):
+      values  (W, R, C, RP) uint32 — run values per predicate column,
+              padded with the NO_MATCH sentinel
+      lengths (W, R, C, RP) int32  — run lengths (0 = padding run)
+      codes   (W, R, C, K) uint32  — accepted code sets per shard
+      valid   (W, R, N) bool
+    Returns (mask (W, R, N) bool, hits (W, 1) int32).
+    """
+
+    from tempo_tpu.ops.pallas_kernels import rle_cols_hit
+
+    def local(values, lengths, codes, valid):
+        hit = rle_cols_hit(values, lengths, codes, n_pad, valid)
+        count = jnp.sum(hit.astype(jnp.int32))
+        total = jax.lax.psum(count, RANGE_AXIS)
+        return hit, total
+
+    def step(values, lengths, codes, valid):
+        hit, total = local(values[0, 0], lengths[0, 0], codes[0, 0], valid[0, 0])
+        return hit[None, None], total[None, None]
+
+    spec = P(WINDOW_AXIS, RANGE_AXIS)
+    return jax.jit(
+        shard_map_compat(
+            step,
+            mesh=mesh,
+            in_specs=(spec, spec, spec, spec),
+            out_specs=(spec, P(WINDOW_AXIS)),
+        )
+    )
+
+
+@lru_cache(maxsize=32)
 def make_sharded_tag_scan_per_shard(mesh, n_cols: int, max_codes: int = 64):
     """Like make_sharded_tag_scan, but the accepted code sets are
     SHARDED with the rows: codes (W, R, C, K). Needed when shards come
@@ -240,8 +282,8 @@ class MeshSearcher:
         zm = zone_maps_enabled()
         resp = SearchResponse()
         stats = self.last_stats = {
-            "dispatches": 0, "units_scanned": 0, "h2d_bytes": 0,
-            "d2h_bytes": 0, "collectives": 0,
+            "dispatches": 0, "units_scanned": 0, "units_runspace": 0,
+            "h2d_bytes": 0, "d2h_bytes": 0, "collectives": 0,
             "per_shard_rows": np.zeros(self.w * self.r, np.int64),
         }
         opened: list = []
@@ -258,10 +300,13 @@ class MeshSearcher:
         def collect(blk, i, rg, preds, span_mask):
             nonlocal done
             # feed the cached predicate columns back so hits_for_mask does
-            # not re-read pages the device scan already pulled
+            # not re-read pages the device scan already pulled — but only
+            # columns that actually expanded; encoded pages stay encoded
+            # (the run-space hit collector gathers from them directly)
             have = {
                 name: self._col(blk, i, rg, name)
                 for name, _ in preds["span_eq"]
+                if blk.encoded_column(rg, name) is None
             }
             if preds["attr"]:
                 from tempo_tpu.encoding.vtpu.block import attr_predicate_mask
@@ -307,39 +352,105 @@ class MeshSearcher:
                         done = True
                         return
                 return
-            scan = self._scan(n_cols)
             pad = self.bucket_for(max(rg.n_spans for _, _, rg, _ in chunk))
-            cols = np.zeros((cap, n_cols, pad), np.uint32)
             codes = np.full((cap, n_cols, self.max_codes), NO_MATCH, np.uint32)
             valid = np.zeros((cap, pad), bool)
             live = []
-            for s, (blk, i, rg, preds) in enumerate(chunk):
-                try:
-                    for c, (col_name, accept) in enumerate(preds["span_eq"]):
-                        cols[s, c, : rg.n_spans] = with_retries(
-                            lambda b=blk, j=i, r=rg, n=col_name: self._col(b, j, r, n))
+
+            # zero-decode run path: when EVERY unit's predicate pages
+            # are rle, ship the runs themselves — H2D carries the
+            # encoded form and the device fuses expansion + compare
+            # (make_sharded_rle_scan); mixed chunks take the expanded
+            # row path below, bit-identically.
+            unit_encs: list | None = []
+            for blk, i, rg, preds in chunk:
+                row = []
+                for col_name, _ in preds["span_eq"]:
+                    enc = blk.encoded_column(rg, col_name)
+                    if enc is None or enc.codec != "rle":
+                        unit_encs = None
+                        break
+                    row.append(enc)
+                if unit_encs is None:
+                    break
+                unit_encs.append(row)
+
+            if unit_encs is not None:
+                max_runs = 8
+                unit_runs = []
+                for s, (blk, i, rg, preds) in enumerate(chunk):
+                    try:
+                        runs = [with_retries(e.runs) for e in unit_encs[s]]
+                    except Exception as e:  # e.g. block deleted mid-query
+                        errors.append((blk, e))
+                        log.warning("mesh search: run load failed: %s", e)
+                        unit_runs.append(None)
+                        continue
+                    unit_runs.append(runs)
+                    for v, l in runs:
+                        max_runs = max(max_runs, len(l))
+                run_pad = 1 << (max_runs - 1).bit_length()
+                values = np.full((cap, n_cols, run_pad), NO_MATCH, np.uint32)
+                lengths = np.zeros((cap, n_cols, run_pad), np.int32)
+                for s, (blk, i, rg, preds) in enumerate(chunk):
+                    if unit_runs[s] is None:
+                        continue
+                    for c, ((col_name, accept), (v, l)) in enumerate(
+                            zip(preds["span_eq"], unit_runs[s])):
+                        values[s, c, : len(v)] = v.astype(np.uint32)
+                        lengths[s, c, : len(l)] = l
                         k = min(len(accept), self.max_codes)
                         codes[s, c, :k] = accept[:k]
-                except Exception as e:  # e.g. block deleted mid-query
-                    errors.append((blk, e))
-                    log.warning("mesh search: column load failed: %s", e)
-                    continue
-                for c in range(len(preds["span_eq"]), n_cols):
-                    # unit has fewer predicates than the widest: accept-all
-                    codes[s, c, 0] = 0
-                valid[s, : rg.n_spans] = True
-                live.append(s)
-            with _dispatch_lock:
-                masks, _totals = scan(
-                    jnp.asarray(cols.reshape(self.w, self.r, n_cols, pad)),
-                    jnp.asarray(codes.reshape(self.w, self.r, n_cols, self.max_codes)),
-                    jnp.asarray(valid.reshape(self.w, self.r, pad)),
-                )
-                masks_np = np.asarray(masks).reshape(cap, pad)
+                    for c in range(len(preds["span_eq"]), n_cols):
+                        # fewer predicates than the widest: accept-all
+                        # (one all-covering run of value 0, code 0)
+                        values[s, c, 0] = 0
+                        lengths[s, c, 0] = rg.n_spans
+                        codes[s, c, 0] = 0
+                    valid[s, : rg.n_spans] = True
+                    live.append(s)
+                scan = make_sharded_rle_scan(self.mesh, n_cols, self.max_codes, pad)
+                with _dispatch_lock:
+                    masks, _totals = scan(
+                        jnp.asarray(values.reshape(self.w, self.r, n_cols, run_pad)),
+                        jnp.asarray(lengths.reshape(self.w, self.r, n_cols, run_pad)),
+                        jnp.asarray(codes.reshape(self.w, self.r, n_cols, self.max_codes)),
+                        jnp.asarray(valid.reshape(self.w, self.r, pad)),
+                    )
+                    masks_np = np.asarray(masks).reshape(cap, pad)
+                stats["units_runspace"] += len(live)
+                stats["h2d_bytes"] += (values.nbytes + lengths.nbytes
+                                       + codes.nbytes + valid.nbytes)
+            else:
+                scan = self._scan(n_cols)
+                cols = np.zeros((cap, n_cols, pad), np.uint32)
+                for s, (blk, i, rg, preds) in enumerate(chunk):
+                    try:
+                        for c, (col_name, accept) in enumerate(preds["span_eq"]):
+                            cols[s, c, : rg.n_spans] = with_retries(
+                                lambda b=blk, j=i, r=rg, n=col_name: self._col(b, j, r, n))
+                            k = min(len(accept), self.max_codes)
+                            codes[s, c, :k] = accept[:k]
+                    except Exception as e:  # e.g. block deleted mid-query
+                        errors.append((blk, e))
+                        log.warning("mesh search: column load failed: %s", e)
+                        continue
+                    for c in range(len(preds["span_eq"]), n_cols):
+                        # unit has fewer predicates than the widest: accept-all
+                        codes[s, c, 0] = 0
+                    valid[s, : rg.n_spans] = True
+                    live.append(s)
+                with _dispatch_lock:
+                    masks, _totals = scan(
+                        jnp.asarray(cols.reshape(self.w, self.r, n_cols, pad)),
+                        jnp.asarray(codes.reshape(self.w, self.r, n_cols, self.max_codes)),
+                        jnp.asarray(valid.reshape(self.w, self.r, pad)),
+                    )
+                    masks_np = np.asarray(masks).reshape(cap, pad)
+                stats["h2d_bytes"] += cols.nbytes + codes.nbytes + valid.nbytes
             stats["dispatches"] += 1
             stats["units_scanned"] += len(live)
             stats["collectives"] += 1  # psum of the per-window hit count
-            stats["h2d_bytes"] += cols.nbytes + codes.nbytes + valid.nbytes
             stats["d2h_bytes"] += masks_np.nbytes
             stats["per_shard_rows"] += valid.sum(axis=1)
             for s in live:
@@ -421,6 +532,7 @@ class MeshSearcher:
         # inspected bytes = actual IO of every opened block (cache hits
         # cost no IO and are deliberately not counted)
         resp.inspected_bytes = sum(b.bytes_read for b in opened)
+        resp.decoded_bytes = sum(getattr(b, "decoded_bytes", 0) for b in opened)
         resp.coalesced_reads = sum(getattr(b, "coalesced_reads", 0) for b in opened)
         return resp
 

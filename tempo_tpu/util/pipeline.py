@@ -110,9 +110,33 @@ def prefetch_iter(iterable, depth: int = 2, join_timeout_s: float = 60.0):
             )
 
 
+def _item_nbytes(item) -> int:
+    """Best-effort size of a prefetched item (dict of numpy arrays,
+    one array, or bytes) — feeds the wasted-bytes counter."""
+    if isinstance(item, dict):
+        return sum(getattr(v, "nbytes", len(v) if isinstance(v, (bytes, bytearray)) else 0)
+                   for v in item.values())
+    return getattr(item, "nbytes", len(item) if isinstance(item, (bytes, bytearray)) else 0)
+
+
 class ReadAhead:
     """One-slot lookahead for a pull-based loader: while the consumer
-    works on item i, a worker thread loads item i+1."""
+    works on item i, a worker thread loads item i+1.
+
+    Observability: process-wide counters (through the register_collector
+    seam in util/metrics, like the column-cache gauges) expose whether
+    the lookahead actually lands — `tempodb_search_prefetch_hits_total`
+    (get() served by a completed prefetch), `..._misses_total` (cold or
+    out-of-order loads paid inline), and `..._wasted_bytes_total`
+    (prefetched items abandoned at close, e.g. a search that hit its
+    limit early — bytes loaded for nothing).
+    """
+
+    # class-level aggregates; the metrics collector snapshots them at
+    # every exposition (values only grow, counter semantics hold)
+    _totals_lock = threading.Lock()
+    _totals = {"hits": 0, "misses": 0, "wasted_bytes": 0}
+    _metrics_registered = False
 
     def __init__(self, load, n_items: int):
         self._load = load
@@ -124,6 +148,43 @@ class ReadAhead:
             if n_items > 1 and overlap_enabled()
             else None
         )
+        self._register_metrics()
+
+    @classmethod
+    def _bump(cls, key: str, amount: int = 1) -> None:
+        with cls._totals_lock:
+            cls._totals[key] += amount
+
+    @classmethod
+    def _register_metrics(cls) -> None:
+        if cls._metrics_registered:
+            return
+        cls._metrics_registered = True
+        from tempo_tpu.util import metrics
+
+        gauges = {
+            "hits": metrics.counter(
+                "tempodb_search_prefetch_hits_total",
+                "ReadAhead gets served by a completed prefetch"),
+            "misses": metrics.counter(
+                "tempodb_search_prefetch_misses_total",
+                "ReadAhead cold/out-of-order loads paid inline"),
+            "wasted_bytes": metrics.counter(
+                "tempodb_search_prefetch_wasted_bytes_total",
+                "Bytes prefetched but abandoned at close (early exit)"),
+        }
+
+        def collect():
+            with cls._totals_lock:
+                snap = dict(cls._totals)
+            for key, c in gauges.items():
+                # counters only move forward: publish the delta since
+                # the last exposition
+                delta = snap[key] - c.value()
+                if delta > 0:
+                    c.inc(delta)
+
+        metrics.register_collector(collect)
 
     def _schedule(self):
         if self._pool is not None and self._next < self._n:
@@ -136,13 +197,20 @@ class ReadAhead:
             fut, self._future = self._future, None
             self._next += 1
             self._schedule()
+            self._bump("hits")
             return fut.result()
         # cold path (first call or out-of-order): load inline, then look ahead
         item = self._load(i)
         self._next = i + 1
         self._schedule()
+        self._bump("misses")
         return item
 
     def close(self):
+        fut, self._future = self._future, None
+        if fut is not None and fut.done() and fut.exception() is None:
+            # loaded but never consumed: the lookahead overshot (early
+            # exit on limit) — account the bytes it cost
+            self._bump("wasted_bytes", _item_nbytes(fut.result()))
         if self._pool is not None:
             self._pool.shutdown(wait=False, cancel_futures=True)

@@ -1,0 +1,371 @@
+"""Run-space vs row-space parity: the zero-decode query path.
+
+The lightweight encoding tier must never change RESULTS — only where
+the bytes get (or don't get) expanded. Every test here runs the same
+query twice (TEMPO_TPU_RUNSPACE=1/0) or against legacy-codec blocks
+(TEMPO_TPU_LIGHTWEIGHT=0 at write time) and asserts bit-identical
+output, plus the economy claims (decodedBytes tracks selectivity;
+legacy blocks upgrade on compaction while old blocks read unchanged).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from tempo_tpu.backend import LocalBackend, TypedBackend
+from tempo_tpu.encoding import from_version
+from tempo_tpu.encoding.common import BlockConfig, SearchRequest
+from tempo_tpu.encoding.vtpu import codec as codec_mod
+from tempo_tpu.encoding.vtpu.colcache import shared_cache
+from tempo_tpu.model import synth
+
+ENC = from_version("vtpu1")
+
+
+def _clear_cache():
+    cache = shared_cache()
+    if cache is not None:
+        cache.clear()
+
+
+class _env:
+    def __init__(self, **kv):
+        self.kv = kv
+        self.old = {}
+
+    def __enter__(self):
+        for k, v in self.kv.items():
+            self.old[k] = os.environ.get(k)
+            os.environ[k] = v
+
+    def __exit__(self, *a):
+        for k, v in self.old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _corpus(backend, cfg, n_blocks=3, lightweight=True):
+    metas = []
+    env = {} if lightweight else {"TEMPO_TPU_LIGHTWEIGHT": "0"}
+    with _env(**env):
+        for j in range(n_blocks):
+            b = synth.make_batch(256, 8, seed=900 + j)
+            rng = np.random.default_rng(910 + j)
+            needle = b.dictionary.add("needle-svc")
+            svc = b.cols["service"].copy()
+            svc[64:96] = np.uint32(needle)
+            b.cols["service"] = svc
+            dur = rng.integers(10**5, 10**7, size=b.num_spans).astype(np.uint64)
+            dur[100:120] = rng.integers(10**10, 2 * 10**10, size=20).astype(np.uint64)
+            b.cols["duration_nano"] = dur
+            metas.append(ENC.create_block([b], "t", backend, cfg))
+    return metas
+
+
+def _hit_tuples(resp):
+    return sorted(
+        (t.trace_id_hex, t.root_service_name, t.root_trace_name,
+         t.start_time_unix_nano, t.duration_ms)
+        for t in resp.traces
+    )
+
+
+QUERIES = [
+    SearchRequest(tags={"service": "needle-svc"}, limit=0),
+    SearchRequest(min_duration_ns=10**9, limit=0),
+    SearchRequest(tags={"service": "needle-svc"}, min_duration_ns=1, limit=0),
+    SearchRequest(tags={"service": "needle-svc"}, limit=3),
+    SearchRequest(tags={"service": "needle-svc"},
+                  start_seconds=1, end_seconds=2 * 10**9, limit=0),
+    SearchRequest(tags={"http.method": "GET"}, limit=0),
+]
+
+
+class TestSearchParity:
+    @pytest.mark.parametrize("qi", range(len(QUERIES)))
+    def test_runspace_equals_rowspace(self, tmp_path, qi):
+        backend = TypedBackend(LocalBackend(str(tmp_path)))
+        cfg = BlockConfig(row_group_spans=128)
+        metas = _corpus(backend, cfg)
+        req = QUERIES[qi]
+        out = {}
+        for arm in ("1", "0"):
+            with _env(TEMPO_TPU_RUNSPACE=arm):
+                _clear_cache()
+                hits = []
+                for m in metas:
+                    hits.extend(_hit_tuples(ENC.open_block(m, backend, cfg).search(req)))
+                out[arm] = sorted(hits)
+        assert out["1"] == out["0"]
+        assert out["1"]  # the corpus matches something for every query
+
+    def test_legacy_codec_blocks_agree(self, tmp_path):
+        """Blocks written entirely on the entropy tier answer every
+        query identically to lightweight-tier blocks of the same data."""
+        backend = TypedBackend(LocalBackend(str(tmp_path)))
+        cfg = BlockConfig(row_group_spans=128)
+        light = _corpus(backend, cfg, lightweight=True)
+        legacy = _corpus(TypedBackend(LocalBackend(str(tmp_path / "legacy"))),
+                         cfg, lightweight=False)
+        legacy_backend = TypedBackend(LocalBackend(str(tmp_path / "legacy")))
+        for req in QUERIES:
+            _clear_cache()
+            a = sorted(sum((_hit_tuples(ENC.open_block(m, backend, cfg).search(req))
+                            for m in light), []))
+            b = sorted(sum((_hit_tuples(ENC.open_block(m, legacy_backend, cfg).search(req))
+                            for m in legacy), []))
+            assert a == b
+
+    def test_decoded_bytes_track_selectivity(self, tmp_path):
+        backend = TypedBackend(LocalBackend(str(tmp_path)))
+        cfg = BlockConfig(row_group_spans=128)
+        metas = _corpus(backend, cfg)
+        req = SearchRequest(tags={"service": "needle-svc"}, limit=0)
+        dec = {}
+        for arm in ("1", "0"):
+            with _env(TEMPO_TPU_RUNSPACE=arm):
+                _clear_cache()
+                dec[arm] = sum(
+                    ENC.open_block(m, backend, cfg).search(req).decoded_bytes
+                    for m in metas)
+        assert 0 < dec["1"] < dec["0"]
+
+    def test_fetch_candidates_parity(self, tmp_path):
+        from tempo_tpu.traceql.parser import parse
+
+        backend = TypedBackend(LocalBackend(str(tmp_path)))
+        cfg = BlockConfig(row_group_spans=128)
+        metas = _corpus(backend, cfg)
+        spec = parse('{ resource.service.name = `needle-svc` }').conditions()
+        out = {}
+        for arm in ("1", "0"):
+            with _env(TEMPO_TPU_RUNSPACE=arm):
+                _clear_cache()
+                ids = []
+                for m in metas:
+                    blk = ENC.open_block(m, backend, cfg)
+                    ids.extend(t.trace_id.hex() for t in blk.fetch_candidates(spec))
+                out[arm] = sorted(ids)
+        assert out["1"] == out["0"] and out["1"]
+
+
+class TestMetricsParity:
+    QS = [
+        "{ resource.service.name = `needle-svc` } | rate() by (name)",
+        "{ resource.service.name = `needle-svc` && duration > 1ms } | rate()",
+        "{} | quantile_over_time(duration, 0.5, 0.99)",
+        "{ name =~ `GET.*` } | count_over_time()",
+        # literal-on-LHS: the encoded path must FLIP the comparison on
+        # operand swap (`1ms < duration` is `duration > 1ms`) — the
+        # unflipped swap inverted this mask
+        "{ 1ms < duration } | rate()",
+        "{ `needle-svc` = resource.service.name } | rate()",
+    ]
+
+    @pytest.mark.parametrize("q", QS)
+    def test_runspace_filters_equal_rowspace(self, tmp_path, q):
+        from tempo_tpu.metrics_engine import (
+            compile_metrics_plan,
+            evaluate_block,
+            make_accumulator,
+        )
+
+        backend = TypedBackend(LocalBackend(str(tmp_path)))
+        cfg = BlockConfig(row_group_spans=128)
+        metas = _corpus(backend, cfg)
+        out = {}
+        for arm in ("1", "0"):
+            with _env(TEMPO_TPU_RUNSPACE=arm):
+                _clear_cache()
+                plan = compile_metrics_plan(q, 1_600_000_000, 1_800_000_000, 10**7)
+                acc = make_accumulator(plan, device=False)
+                for m in metas:
+                    evaluate_block(plan, ENC.open_block(m, backend, cfg), acc)
+                out[arm] = (acc.merged_counts().copy(), dict(acc.series.slots))
+        assert (out["1"][0] == out["0"][0]).all()
+        assert out["1"][1] == out["0"][1]
+        assert out["1"][0].sum() > 0
+
+    def test_encoded_mask_flips_swapped_comparisons(self):
+        """`100 < duration` must evaluate as `duration > 100` in encoded
+        space (the unflipped operand swap inverted the mask), and
+        literal-on-LHS regex must DECLINE (row space raises Unsupported
+        and falls back to the object engine — the encoded arm answering
+        it would break parity)."""
+        from tempo_tpu.model.columnar import Dictionary
+        from tempo_tpu.traceql import vector
+        from tempo_tpu.traceql.parser import parse
+
+        class FakeEnc:
+            codec = "rle"
+
+            def __init__(self, vals):
+                self.vals = np.asarray(vals)
+
+            def map_mask(self, fn):
+                return np.asarray(fn(self.vals), bool)
+
+        durs = FakeEnc(np.array([50, 150], np.uint64))
+        d = Dictionary(["", "x"])
+
+        def enc_of(name):
+            return durs if name == "duration_nano" else None
+
+        expr = parse("{ 100 < duration }").stages[0].expr
+        m = vector._enc_expr_mask(expr, enc_of, d, 2)
+        assert m is not None and m.tolist() == [False, True]
+        expr = parse("{ duration > 100 }").stages[0].expr
+        assert vector._enc_expr_mask(expr, enc_of, d, 2).tolist() == [False, True]
+        # literal-on-LHS regex: the PARSER already rejects it, and the
+        # encoded path declines the AST shape too (defense in depth —
+        # the row-space arm treats it as Unsupported)
+        from tempo_tpu.traceql import ast_nodes as A
+        from tempo_tpu.traceql.parser import ParseError
+
+        with pytest.raises(ParseError):
+            parse("{ `x.*` =~ name }")
+        expr = A.Binary(op="=~", lhs=A.Literal(value="x.*", kind="string"),
+                        rhs=A.Intrinsic(name="name"))
+        names = FakeEnc(np.array([1, 1], np.uint32))
+        assert vector._enc_expr_mask(
+            expr, lambda n: names if n == "name" else None, d, 2) is None
+
+    def test_device_and_host_accumulators_agree(self, tmp_path):
+        from tempo_tpu.metrics_engine import (
+            compile_metrics_plan,
+            evaluate_block,
+            make_accumulator,
+        )
+
+        backend = TypedBackend(LocalBackend(str(tmp_path)))
+        cfg = BlockConfig(row_group_spans=128)
+        metas = _corpus(backend, cfg)
+        counts = {}
+        for device in (True, False):
+            _clear_cache()
+            plan = compile_metrics_plan(
+                "{} | quantile_over_time(duration, 0.5)",
+                1_600_000_000, 1_800_000_000, 10**7)
+            acc = make_accumulator(plan, device=device)
+            for m in metas:
+                evaluate_block(plan, ENC.open_block(m, backend, cfg), acc)
+            counts[device] = acc.merged_counts()
+        assert (counts[True] == counts[False]).all()
+
+
+class TestMeshRunspace:
+    def test_mesh_search_run_path_parity(self, tmp_path):
+        import jax
+
+        from tempo_tpu.parallel.mesh import get_mesh
+        from tempo_tpu.parallel.search import MeshSearcher
+
+        if len(jax.devices()) < 1:
+            pytest.skip("no devices")
+        backend = TypedBackend(LocalBackend(str(tmp_path)))
+        cfg = BlockConfig(row_group_spans=128)
+        metas = _corpus(backend, cfg)
+        req = SearchRequest(tags={"service": "needle-svc"}, limit=0)
+        mesh = get_mesh()
+        searcher = MeshSearcher(mesh, cfg.bucket_for)
+
+        def blocks():
+            return (ENC.open_block(m, backend, cfg) for m in metas)
+
+        _clear_cache()
+        mesh_resp = searcher.search_blocks(blocks(), req)
+        # the run path actually engaged (service pages are rle)
+        assert searcher.last_stats.get("units_runspace", 0) > 0
+        _clear_cache()
+        with _env(TEMPO_TPU_RUNSPACE="0"):
+            row_resp = searcher.search_blocks(blocks(), req)
+        assert _hit_tuples(mesh_resp) == _hit_tuples(row_resp)
+        single = []
+        _clear_cache()
+        for m in metas:
+            single.extend(_hit_tuples(ENC.open_block(m, backend, cfg).search(req)))
+        assert sorted(single) == _hit_tuples(mesh_resp)
+
+
+class TestCompactionUpgrade:
+    def test_legacy_blocks_gain_lightweight_codecs(self, tmp_path):
+        """Old blocks (entropy tier only) read unchanged AND their
+        compaction output carries lightweight pages; the zero-decode
+        relocation fast path still runs."""
+        from tempo_tpu.encoding.common import CompactionOptions
+        from tempo_tpu.encoding.vtpu.compactor import VtpuCompactor
+
+        backend = TypedBackend(LocalBackend(str(tmp_path)))
+        cfg = BlockConfig(row_group_spans=128)
+        # disjoint trace-ID halves: the relocation fast path's shape
+        metas = []
+        with _env(TEMPO_TPU_LIGHTWEIGHT="0"):
+            for j, high in enumerate((False, True)):
+                b = synth.make_batch(256, 8, seed=940 + j)
+                tid = b.cols["trace_id"].copy()
+                if high:
+                    tid[:, 0] |= np.uint32(0x80000000)
+                else:
+                    tid[:, 0] &= np.uint32(0x7FFFFFFF)
+                b.cols["trace_id"] = tid
+                metas.append(ENC.create_block([b.sorted_by_trace()], "t", backend, cfg))
+        for m in metas:
+            blk = ENC.open_block(m, backend, cfg)
+            for rg in blk.index().row_groups:
+                assert all(p.codec not in codec_mod.LIGHTWEIGHT_CODECS
+                           for p in rg.pages.values())
+            # legacy blocks answer queries unchanged
+            resp = blk.search(SearchRequest(tags={"service": "needle-svc"}, limit=0))
+            assert resp.status == "complete"
+
+        comp = VtpuCompactor(CompactionOptions(block_config=cfg, zero_decode=True))
+        (out,) = comp.compact(metas, "t", backend)
+        assert comp.pages_copied_verbatim > 0  # fast path preserved
+        blk = ENC.open_block(out, backend, cfg)
+        gained = set()
+        for rg in blk.index().row_groups:
+            for name, p in rg.pages.items():
+                if p.codec in codec_mod.LIGHTWEIGHT_CODECS:
+                    gained.add(name)
+        # the upgrade covers at least the ID column (decoded by the
+        # relocation guard anyway) and the stats back-fill columns
+        assert "trace_id" in gained
+
+    def test_modern_blocks_relocate_lightweight_pages_verbatim(self, tmp_path):
+        from tempo_tpu.encoding.common import CompactionOptions
+        from tempo_tpu.encoding.vtpu.compactor import VtpuCompactor
+
+        backend = TypedBackend(LocalBackend(str(tmp_path)))
+        cfg = BlockConfig(row_group_spans=128)
+        metas = []
+        for j, high in enumerate((False, True)):
+            b = synth.make_batch(256, 8, seed=960 + j)
+            tid = b.cols["trace_id"].copy()
+            if high:
+                tid[:, 0] |= np.uint32(0x80000000)
+            else:
+                tid[:, 0] &= np.uint32(0x7FFFFFFF)
+            b.cols["trace_id"] = tid
+            metas.append(ENC.create_block([b.sorted_by_trace()], "t", backend, cfg))
+        in_light = {
+            (rg.min_id, name): (p.codec, p.crc)
+            for m in metas
+            for rg in ENC.open_block(m, backend, cfg).index().row_groups
+            for name, p in rg.pages.items()
+            if p.codec in codec_mod.LIGHTWEIGHT_CODECS
+        }
+        assert in_light
+        comp = VtpuCompactor(CompactionOptions(block_config=cfg, zero_decode=True))
+        (out,) = comp.compact(metas, "t", backend)
+        blk = ENC.open_block(out, backend, cfg)
+        for rg in blk.index().row_groups:
+            for name, p in rg.pages.items():
+                want = in_light.get((rg.min_id, name))
+                if want is not None:
+                    # same codec, same payload crc: relocated, not re-encoded
+                    assert (p.codec, p.crc) == want

@@ -247,7 +247,8 @@ class TestRelocationStats:
 
     def _recomputed_stats(self, blk, rg):
         cols = blk.read_columns(
-            rg, [c for c in fmt.STATS_NUMERIC + fmt.STATS_CODES if c in rg.pages])
+            rg, [c for c in fmt.STATS_NUMERIC + fmt.STATS_CODES
+                 + ("trace_id", "parent_span_id") if c in rg.pages])
         return fmt.compute_stats(cols)
 
     def test_zero_decode_relocation_carries_correct_stats(self, tmp_path):
