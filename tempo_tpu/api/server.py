@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import threading
 import time
 import traceback
@@ -38,8 +39,10 @@ from tempo_tpu.api.params import BadRequest
 from tempo_tpu.app import RoleUnavailable
 from tempo_tpu.modules.distributor import RateLimited
 from tempo_tpu.modules.ingester import MaxLiveTraces, TraceTooLarge
+from tempo_tpu.modules.queue import TooManyRequests
 from tempo_tpu.receivers import otlp
 from tempo_tpu.util import metrics
+from tempo_tpu.util.resource import ResourceExhausted
 
 VERSION = "0.1.0"
 
@@ -87,10 +90,13 @@ class _Handler(BaseHTTPRequestHandler):
         log.debug("http: " + fmt, *args)
 
     # -- plumbing ------------------------------------------------------
-    def _send(self, code: int, body: bytes, content_type: str = "application/json"):
+    def _send(self, code: int, body: bytes, content_type: str = "application/json",
+              headers: dict | None = None):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         if self.close_connection:
             self.send_header("Connection", "close")
         self.end_headers()
@@ -102,12 +108,22 @@ class _Handler(BaseHTTPRequestHandler):
     def _send_json(self, code: int, doc) -> None:
         self._send(code, json.dumps(doc).encode())
 
-    def _send_error(self, code: int, msg: str) -> None:
+    def _send_error(self, code: int, msg: str, headers: dict | None = None) -> None:
         # error paths may not have drained the request body; keeping the
         # HTTP/1.1 connection alive would desync the next request on the
         # socket with the unread bytes
         self.close_connection = True
-        self._send(code, (msg.rstrip("\n") + "\n").encode(), "text/plain; charset=utf-8")
+        self._send(code, (msg.rstrip("\n") + "\n").encode(),
+                   "text/plain; charset=utf-8", headers=headers)
+
+    def _send_shed(self, e: Exception) -> None:
+        """One shape for every shed/backpressure rejection: 429 with a
+        Retry-After computed from the limiter refill / governor state, so
+        well-behaved clients pace their retries instead of hammering
+        (reference: the distributor's rate-limit translation plus dskit's
+        Retry-After middleware)."""
+        retry_after = max(1, math.ceil(getattr(e, "retry_after_s", 1.0)))
+        self._send_error(429, str(e), headers={"Retry-After": str(retry_after)})
 
     def _org_id(self) -> str | None:
         return self.headers.get("X-Scope-OrgID")
@@ -179,9 +195,10 @@ class _Handler(BaseHTTPRequestHandler):
         except PermissionError as e:
             code = 401
             self._send_error(401, str(e))
-        except RateLimited as e:
+        except (RateLimited, ResourceExhausted, TooManyRequests) as e:
+            # rate limits AND overload sheds: 429 with a Retry-After hint
             code = 429
-            self._send_error(429, str(e))
+            self._send_shed(e)
         except (TraceTooLarge, MaxLiveTraces) as e:
             # reference maps resource-exhausted pushes to 429 (distributor
             # push error translation)
@@ -260,7 +277,13 @@ class _Handler(BaseHTTPRequestHandler):
                 # wire/thrift/json decode errors and shape-invalid JSON
                 raise BadRequest(f"malformed payload: {e}") from e
             if traces:
-                app.push_traces(traces, org_id=self._org_id())
+                try:
+                    app.push_traces(traces, org_id=self._org_id())
+                except ValueError as e:
+                    # distributor admission contract: ValueError = the
+                    # request can never be admitted (e.g. one batch over
+                    # the whole inflight budget) — client error, not 500
+                    raise BadRequest(str(e)) from e
             if path == receivers.OTLP_HTTP_PATH:
                 # OTLP/HTTP: response content type must match the request;
                 # empty ExportTraceServiceResponse = empty proto message
@@ -489,6 +512,17 @@ class _Handler(BaseHTTPRequestHandler):
     def _search(self, qs: dict) -> int:
         req = api_params.parse_search_request(qs)
         org = self._org_id()
+        try:
+            return self._search_inner(req, org)
+        except ValueError as e:
+            # the frontend's contract on both search paths: ValueError =
+            # window/size/admission problem, a client error end to end
+            # ("narrow the time range", max_search_duration, ...) — the
+            # guidance must reach the caller as 400, not vanish into a
+            # 500 that retrying clients hammer
+            raise BadRequest(str(e)) from e
+
+    def _search_inner(self, req, org) -> int:
         if req.query:
             stats: dict = {}
             t0 = time.monotonic()

@@ -33,6 +33,7 @@ from tempo_tpu.modules.rpc import (
     RPCHandler,
 )
 from tempo_tpu.modules.worker import JobBroker, LocalWorkerPool, RemoteWorker
+from tempo_tpu.util import resource
 
 log = logging.getLogger(__name__)
 
@@ -82,6 +83,11 @@ class AppConfig:
     # ring health: instances missing heartbeats this long are excluded
     # from replica sets (reference: dskit ring HeartbeatTimeout)
     ring_heartbeat_timeout_s: float = 60.0
+    # overload control plane budgets (util/resource): pools + watermarks
+    # that drive the process pressure level and admission gates
+    resource: "resource.ResourceConfig" = field(
+        default_factory=resource.ResourceConfig
+    )
 
 
 class RoleUnavailable(RuntimeError):
@@ -94,6 +100,10 @@ class App:
 
         ensure_persistent_cache()  # daemon startup: arm the compile cache
         self.cfg = cfg
+        # (re)apply the overload budgets to the process-wide governor —
+        # pools persist across App rebuilds (modules hold references),
+        # only the limits/watermarks move
+        self.governor = resource.configure(cfg.resource)
         target = cfg.target or "all"
         if target not in ROLES:
             raise ValueError(f"unknown target {target!r} (have {ROLES})")
@@ -159,6 +169,17 @@ class App:
     def _make_db(self) -> TempoDB:
         return TempoDB(self.cfg.db)
 
+    def _query_breaker(self):
+        """Shared breaker around query-job execution: a sustained
+        backend outage opens it after 10 consecutive job failures
+        (transient chaos-level flakes never string 10 in a row), after
+        which every retry fails fast instead of re-hammering the backend
+        until a half-open probe succeeds."""
+        from tempo_tpu.util.circuit import CircuitBreaker
+
+        return CircuitBreaker(name="query-backend", failure_threshold=10,
+                              reset_timeout_s=5.0)
+
     # ------------------------------------------------------------------
     def _build_all(self):
         cfg = self.cfg
@@ -206,7 +227,8 @@ class App:
         )
         self.querier = Querier(self.db, self.ring, ingester_clients=self.ingesters)
         self.broker = JobBroker()
-        self.workers = LocalWorkerPool(self.broker, self.querier, cfg.query_workers)
+        self.workers = LocalWorkerPool(self.broker, self.querier, cfg.query_workers,
+                                       breaker=self._query_breaker())
         self.frontend = Frontend(self.broker, self.db, cfg.frontend, self.overrides)
         self.compactor = CompactorModule(self.db, ring=None)
         self.rpc = RPCHandler(
@@ -277,7 +299,8 @@ class App:
             )
             if cfg.frontend_address:
                 self.remote_worker = RemoteWorker(
-                    cfg.frontend_address, self.querier, n_threads=cfg.query_workers
+                    cfg.frontend_address, self.querier, n_threads=cfg.query_workers,
+                    breaker=self._query_breaker(),
                 ).start()
             self.rpc = RPCHandler()
             return

@@ -238,17 +238,26 @@ def retryable_error(e: Exception) -> bool:
     """The retryable-vs-terminal taxonomy (reference: retry.go retries
     5xx only; the SDKs retry connection resets). Terminal: the request
     can never succeed by repetition — missing object, corrupt data,
-    exceeded deadline, or a client mistake."""
+    exceeded deadline, or a client mistake.
+
+    Overload-control errors compose with it: ResourceExhausted (a shed
+    with a retry hint) is retryable-with-backoff, and CircuitOpen is a
+    ConnectionError subclass — retryable by shape, but each retry fails
+    fast locally while the breaker is open, so the bounded retry loops
+    above stop amplifying an outage."""
     from tempo_tpu.encoding.vtpu.codec import CorruptPage
+    from tempo_tpu.util.resource import ResourceExhausted
 
     if isinstance(e, (NotFound, CorruptPage, deadline.DeadlineExceeded)):
         return False
+    if isinstance(e, ResourceExhausted):
+        return True
     if isinstance(e, (ValueError, TypeError, KeyError, PermissionError)):
         return False
     return isinstance(e, (IOError, OSError, ConnectionError, TimeoutError))
 
 
-def with_retries(fn, attempts: int = 3, backoff_s: float = 0.01):
+def with_retries(fn, attempts: int = 3, backoff_s: float = 0.01, breaker=None):
     """Run fn with bounded retries of RETRYABLE errors (taxonomy above),
     backoff clipped to the propagated deadline.
 
@@ -262,10 +271,19 @@ def with_retries(fn, attempts: int = 3, backoff_s: float = 0.01):
     individually likely to succeed, which is how the reference behaves
     too (its object-store SDK retries sit beneath every read). HTTP
     backends already have this in PooledHTTPClient; this covers the
-    local/mock/injected paths that bypass it."""
+    local/mock/injected paths that bypass it.
+
+    breaker: optional util/circuit.CircuitBreaker shared across calls —
+    consecutive retryable failures open it, after which every attempt
+    (here and in every sibling retry loop holding the same breaker)
+    fails fast with CircuitOpen instead of touching the backend, until a
+    half-open probe succeeds. This is what stops N concurrent retry
+    loops from multiplying load on an already-failing backend."""
     last: Exception | None = None
     for i in range(attempts):
         try:
+            if breaker is not None:
+                return breaker.run(fn)
             return fn()
         except Exception as e:  # noqa: BLE001 — classified below
             if not retryable_error(e) or i == attempts - 1:

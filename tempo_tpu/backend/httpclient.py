@@ -32,10 +32,25 @@ hedged_total = metrics.counter(
 
 
 class HTTPError(Exception):
-    def __init__(self, status: int, body: bytes, url: str):
+    def __init__(self, status: int, body: bytes, url: str, headers: dict | None = None):
         self.status = status
         self.body = body[:512]
+        self.headers = headers or {}
         super().__init__(f"HTTP {status} for {url}: {self.body!r}")
+
+    def parse_retry_after(self) -> float | None:
+        """Parsed Retry-After header (seconds form), for 429 shed
+        responses. A method name distinct from the `retry_after_s` FLOAT
+        attribute every overload error carries — duck-typing consumers
+        (`getattr(e, "retry_after_s", 0.0)`) must never pick up a bound
+        method where they expect a number."""
+        v = self.headers.get("retry-after")
+        if v is None:
+            return None
+        try:
+            return float(v)
+        except ValueError:
+            return None
 
 
 def retriable(e: Exception) -> bool:
@@ -61,6 +76,7 @@ class PooledHTTPClient:
         timeout_s: float = 30.0,
         max_retries: int = 3,
         hedge: HedgeConfig | None = None,
+        breaker=None,
     ):
         u = urllib.parse.urlsplit(endpoint)
         if u.scheme not in ("http", "https"):
@@ -71,6 +87,12 @@ class PooledHTTPClient:
         self.timeout_s = timeout_s
         self.max_retries = max_retries
         self.hedge = hedge or HedgeConfig()
+        # optional util/circuit.CircuitBreaker: when the endpoint is down
+        # for everyone, attempts (INCLUDING this client's own retries)
+        # fail fast with CircuitOpen instead of stacking timeouts on a
+        # struggling host — the anti-amplification valve around every
+        # retry loop above this client
+        self.breaker = breaker
         self._pool: list[http.client.HTTPConnection] = []
         self._lock = threading.Lock()
         self._hedge_pool = concurrent.futures.ThreadPoolExecutor(max_workers=8)
@@ -136,23 +158,37 @@ class PooledHTTPClient:
         last: Exception | None = None
         for attempt in range(self.max_retries + 1):
             deadline.check()  # an exceeded deadline is terminal, not retried
+            if self.breaker is not None:
+                # raises CircuitOpen (fail fast, zero I/O) while open —
+                # including for this client's OWN retry attempts, so a
+                # dead endpoint costs microseconds, not stacked timeouts
+                self.breaker.before()
             try:
                 if idempotent and method in ("GET", "HEAD") and self.hedge.hedge_at_s > 0:
                     status, data, h = self._hedged(method, path, headers, body)
                 else:
                     status, data, h = self._once(method, path, headers, body)
-                if status in ok:
-                    return status, data, h
-                err = HTTPError(status, data, path)
-                if not retriable(err) or not idempotent:
-                    raise err
-                last = err
-            except HTTPError:
-                raise
             except Exception as e:  # connection-level failure
+                if self.breaker is not None:
+                    self.breaker.record_failure()
                 if not retriable(e) or not idempotent:
                     raise
                 last = e
+            else:
+                if self.breaker is not None:
+                    # any response proves the transport; only 5xx says the
+                    # backend itself is unhealthy (4xx/429 are the
+                    # caller's problem or explicit backpressure)
+                    if status >= 500:
+                        self.breaker.record_failure()
+                    else:
+                        self.breaker.record_success()
+                if status in ok:
+                    return status, data, h
+                err = HTTPError(status, data, path, headers=h)
+                if not retriable(err) or not idempotent:
+                    raise err
+                last = err
             if attempt < self.max_retries:
                 time.sleep(deadline.bound_timeout(min(0.05 * (2**attempt), 1.0)))
         assert last is not None

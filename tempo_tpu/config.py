@@ -30,6 +30,7 @@ from tempo_tpu.modules.generator.storage import RemoteWriteConfig
 from tempo_tpu.modules.ingester import IngesterConfig
 from tempo_tpu.modules.overrides import Limits
 from tempo_tpu.usagestats import UsageStatsConfig
+from tempo_tpu.util.resource import ResourceConfig
 
 log = logging.getLogger(__name__)
 
@@ -180,6 +181,8 @@ def parse_config(text: str, env: dict | None = None) -> Config:
         raise ConfigError(f"metrics_generator.{next(iter(gen))}: unknown config key")
 
     app.usage_stats = _from_dict(UsageStatsConfig, doc.pop("usage_report", None), "usage_report")
+    # overload control plane budgets (util/resource.ResourceGovernor)
+    app.resource = _from_dict(ResourceConfig, doc.pop("resource", None), "resource")
 
     for key in ("replication_factor", "n_ingesters", "query_workers"):
         if key in doc:
@@ -236,4 +239,22 @@ def check_config(cfg: Config) -> list[str]:
         )
     if app.remote_write is not None and app.remote_write.endpoint and not app.generator_enabled:
         warnings.append("metrics_generator.remote_write set but the generator is disabled")
+    if app.resource.hard_watermark <= app.resource.soft_watermark:
+        warnings.append(
+            f"resource.hard_watermark ({app.resource.hard_watermark}) <= soft_watermark "
+            f"({app.resource.soft_watermark}): pushes will be refused before any "
+            "early-flush pressure response can run"
+        )
+    if app.ingester.max_block_bytes > app.resource.wal_head_bytes > 0:
+        warnings.append(
+            "ingester.max_block_bytes exceeds resource.wal_head_bytes: a single head "
+            "block can push the process to critical pressure before it is cut"
+        )
+    resident_cap = app.frontend.target_bytes_per_job * max(1, app.frontend.query_shards)
+    if 0 < app.resource.inflight_query_bytes < 2 * resident_cap:
+        warnings.append(
+            "resource.inflight_query_bytes is below twice the per-query resident "
+            f"ceiling ({resident_cap} bytes = query_shards x target_bytes_per_job): "
+            "two concurrent broad queries cannot both be admitted"
+        )
     return warnings

@@ -30,16 +30,34 @@ from collections import OrderedDict
 
 
 class ColumnCache:
-    """Bytes-bounded, thread-safe LRU of numpy arrays."""
+    """Bytes-bounded, thread-safe LRU of numpy arrays.
 
-    def __init__(self, max_bytes: int):
+    Pressure-aware: the effective capacity shrinks with the process
+    pressure level (util/resource) — half at PRESSURE, an eighth at
+    CRITICAL — so cached decode results yield memory to live ingest
+    instead of competing with it, and grow back automatically when the
+    pressure clears. The level is consulted on put (the only growth
+    path), never on get."""
+
+    _PRESSURE_FACTORS = {0: 1.0, 1: 0.5, 2: 0.125}
+
+    def __init__(self, max_bytes: int, governor=None):
         self.max_bytes = max_bytes
+        self._governor = governor  # None = process governor, bound lazily
         self._lru: OrderedDict = OrderedDict()
         self._bytes = 0
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+
+    def effective_max_bytes(self) -> int:
+        gov = self._governor
+        if gov is None:
+            from tempo_tpu.util import resource
+
+            gov = self._governor = resource.governor()
+        return int(self.max_bytes * self._PRESSURE_FACTORS.get(gov.level(), 1.0))
 
     def get(self, key):
         with self._lock:
@@ -56,6 +74,7 @@ class ColumnCache:
             arr.setflags(write=False)
         except ValueError:  # non-owned buffer already read-only
             pass
+        limit = self.effective_max_bytes()
         with self._lock:
             prev = self._lru.get(key)
             if prev is not None:
@@ -65,7 +84,7 @@ class ColumnCache:
                 self._bytes -= prev.nbytes
             self._lru[key] = arr
             self._bytes += arr.nbytes
-            while self._bytes > self.max_bytes and self._lru:
+            while self._bytes > limit and self._lru:
                 _, evicted = self._lru.popitem(last=False)
                 self._bytes -= evicted.nbytes
                 self.evictions += 1
@@ -79,6 +98,7 @@ class ColumnCache:
                 "bytes": self._bytes,
                 "entries": len(self._lru),
                 "max_bytes": self.max_bytes,
+                "effective_max_bytes": self.effective_max_bytes(),
             }
 
     def clear(self) -> None:

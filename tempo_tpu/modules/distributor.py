@@ -21,7 +21,7 @@ from tempo_tpu.encoding.vtpu import format as fmt
 from tempo_tpu.model.columnar import SpanBatch
 from tempo_tpu.model.trace import traces_to_batch
 from tempo_tpu.ops import hashing
-from tempo_tpu.util import metrics, tracing
+from tempo_tpu.util import metrics, resource, tracing
 
 log = logging.getLogger(__name__)
 
@@ -34,10 +34,20 @@ bytes_received = metrics.counter(
 discarded_spans = metrics.counter(
     "tempo_discarded_spans_total", "Spans discarded at ingest, by reason"
 )
+inflight_push_gauge = metrics.gauge(
+    "tempo_distributor_inflight_push_bytes",
+    "Bytes of push payloads currently being fanned out",
+)
 
 
 class RateLimited(Exception):
-    """Maps to HTTP 429 (reference: distributor.go:340)."""
+    """Maps to HTTP 429 (reference: distributor.go:340). Carries the
+    token-bucket refill hint so the 429 can say WHEN to retry instead of
+    inviting an immediate re-send."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = max(0.0, float(retry_after_s))
 
 
 class NoHealthyIngesters(Exception):
@@ -50,17 +60,29 @@ class TokenBucket:
         self.burst = burst
         self.tokens = burst
         self.t = time.monotonic()
+        self.last_used = self.t
         self.lock = threading.Lock()
 
     def allow_n(self, n: float) -> bool:
         with self.lock:
             now = time.monotonic()
+            self.last_used = now
             self.tokens = min(self.burst, self.tokens + (now - self.t) * self.rate)
             self.t = now
             if n <= self.tokens:
                 self.tokens -= n
                 return True
             return False
+
+    def retry_after_s(self, n: float) -> float:
+        """Seconds until n tokens will have refilled — the Retry-After
+        hint for a rejected request of size n. Deliberately NOT capped
+        at the burst size: a request larger than the burst gets the
+        honest (long) accrual time rather than a zero hint."""
+        with self.lock:
+            if self.rate <= 0:
+                return 1.0
+            return max(0.0, (n - self.tokens) / self.rate)
 
 
 @dataclass
@@ -72,9 +94,15 @@ class DistributorMetrics:
 
 
 class Distributor:
+    # idle tenants' limiter + per-tenant metric state is evicted after
+    # this long: a tenant-ID fuzzing client must not leak memory forever
+    TENANT_IDLE_TTL_S = 600.0
+    _EVICT_PERIOD_S = 60.0
+
     def __init__(self, ring, ingester_clients: dict, overrides,
                  generator_ring=None, generator_clients: dict | None = None,
-                 forwarder_manager=None, instance_id: str = "distributor-0"):
+                 forwarder_manager=None, instance_id: str = "distributor-0",
+                 governor: "resource.ResourceGovernor | None" = None):
         """ingester_clients: instance_id -> object with
         push_segment(tenant, data: bytes)."""
         self.ring = ring
@@ -84,9 +112,11 @@ class Distributor:
         self.generator_clients = generator_clients or {}
         self.forwarder_manager = forwarder_manager
         self.instance_id = instance_id
+        self.governor = governor or resource.governor()
         self.metrics = DistributorMetrics()
         self._limiters: dict[str, TokenBucket] = {}
         self._lock = threading.Lock()
+        self._last_evict = time.monotonic()
 
     # ------------------------------------------------------------------
     def _limiter(self, tenant: str) -> TokenBucket:
@@ -100,7 +130,40 @@ class Distributor:
             if lim is None or lim.rate != rate or lim.burst != burst:
                 lim = TokenBucket(rate, burst)
                 self._limiters[tenant] = lim
-            return lim
+        self._maybe_evict_idle()
+        return lim
+
+    def _maybe_evict_idle(self, now: float | None = None) -> None:
+        """Opportunistic idle-tenant GC from the push path, at most once
+        per _EVICT_PERIOD_S, so churned/fuzzed tenant IDs stay bounded."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if now - self._last_evict < self._EVICT_PERIOD_S:
+                return
+            self._last_evict = now
+        evicted = self.evict_idle_tenants()
+        if evicted:
+            log.info("evicted %d idle tenant limiter(s)", evicted)
+
+    def evict_idle_tenants(self, older_than_s: float | None = None) -> int:
+        """Drop limiter + per-tenant metric dict entries for tenants idle
+        past the TTL (reference: dskit limiter GC). Returns the count."""
+        ttl = self.TENANT_IDLE_TTL_S if older_than_s is None else older_than_s
+        now = time.monotonic()
+        with self._lock:
+            idle = [
+                t for t, lim in self._limiters.items()
+                if now - lim.last_used > ttl
+            ]
+            for t in idle:
+                del self._limiters[t]
+                for d in (
+                    self.metrics.spans_received,
+                    self.metrics.bytes_received,
+                    self.metrics.traces_rate_limited,
+                ):
+                    d.pop(t, None)
+        return len(idle)
 
     # ------------------------------------------------------------------
     def push_traces(self, tenant: str, traces) -> None:
@@ -120,12 +183,51 @@ class Distributor:
 
     def _push_batch_traced(self, tenant: str, batch: SpanBatch) -> None:
         size = batch.nbytes()
-        if not self._limiter(tenant).allow_n(size):
+        lim = self._limiter(tenant)
+        # note: a batch larger than the tenant burst also lands here with
+        # a (long, honest) refill hint — kept as 429 for reference parity
+        # (Tempo maps every rate-limit rejection to 429) and because the
+        # per-tenant burst is an operator knob, unlike the process-wide
+        # inflight budget below whose overflow is terminal
+        if not lim.allow_n(size):
             self.metrics.traces_rate_limited[tenant] = (
                 self.metrics.traces_rate_limited.get(tenant, 0) + 1
             )
             discarded_spans.inc(batch.num_spans, reason="rate_limited", tenant=tenant)
-            raise RateLimited(f"tenant {tenant}: ingestion rate limit exceeded")
+            raise RateLimited(
+                f"tenant {tenant}: ingestion rate limit exceeded",
+                retry_after_s=lim.retry_after_s(size),
+            )
+        # instance-wide inflight-bytes gate ABOVE the per-tenant buckets
+        # (reference: distributor instance limits): per-tenant buckets
+        # bound steady-state rates, but N tenants' worth of simultaneous
+        # in-limit pushes can still pile up unbounded fan-out memory
+        gate = self.governor.pool("inflight_push")
+        if gate.limit and size > gate.limit:
+            # can NEVER be admitted, even on an idle process — a 429
+            # with a retry hint here would livelock a well-behaved
+            # client. Terminal: split the batch or raise the budget.
+            discarded_spans.inc(batch.num_spans, reason="too_large", tenant=tenant)
+            raise ValueError(
+                f"push of {size} bytes exceeds the whole inflight budget "
+                f"({gate.limit} bytes); send smaller batches"
+            )
+        if not gate.try_add(size):
+            discarded_spans.inc(batch.num_spans, reason="overload", tenant=tenant)
+            resource.shed_total.inc(component="distributor", reason="inflight_push_full")
+            raise resource.ResourceExhausted(
+                f"distributor: inflight push bytes over budget "
+                f"({gate.used}/{gate.limit}); slow down",
+                retry_after_s=self.governor.retry_after_s(),
+            )
+        try:
+            inflight_push_gauge.set(gate.used)
+            self._fan_out(tenant, batch, size)
+        finally:
+            gate.sub(size)
+            inflight_push_gauge.set(gate.used)
+
+    def _fan_out(self, tenant: str, batch: SpanBatch, size: int) -> None:
         self.metrics.spans_received[tenant] = (
             self.metrics.spans_received.get(tenant, 0) + batch.num_spans
         )
@@ -137,6 +239,7 @@ class Distributor:
         if not groups:
             raise NoHealthyIngesters("no healthy ingesters in the ring")
         errs = []
+        shed_errs = []
         for instance_id, sub in groups.items():
             client = self.clients.get(instance_id)
             if client is None:
@@ -144,13 +247,29 @@ class Distributor:
                 continue
             try:
                 client.push_segment(tenant, fmt.serialize_batch(sub))
+            except resource.ResourceExhausted as e:  # ingester refused: overload
+                shed_errs.append(e)
+                errs.append(f"{instance_id}: {e}")
             except Exception as e:  # collect; quorum decided below
                 errs.append(f"{instance_id}: {e}")
         if errs:
             self.metrics.push_failures += len(errs)
             # reference DoBatch succeeds while a quorum of replicas ack;
             # with RF copies per trace, tolerate < RF/2+1 failures
-            if len(errs) > max(0, self.ring.replication_factor - (self.ring.replication_factor // 2 + 1)):
+            rf = self.ring.replication_factor
+            tolerated = max(0, rf - (rf // 2 + 1))
+            if len(errs) > tolerated:
+                # backpressure only if the SHEDS are what broke quorum:
+                # the hard failures alone fitting the tolerance means the
+                # push would have succeeded had nobody shed. Hard outages
+                # breaking quorum on their own must stay a 5xx/IOError —
+                # a 429 there would hide a replica outage from alerting.
+                if shed_errs and len(errs) - len(shed_errs) <= tolerated:
+                    discarded_spans.inc(batch.num_spans, reason="overload", tenant=tenant)
+                    raise resource.ResourceExhausted(
+                        f"push shed by ingesters: {errs}",
+                        retry_after_s=max(e.retry_after_s for e in shed_errs),
+                    )
                 raise IOError(f"push failed: {errs}")
 
         self._send_to_generators(tenant, batch)

@@ -15,14 +15,16 @@ boundary is the broker seam (the reference's httpgrpc boundary).
 
 from __future__ import annotations
 
+import contextlib
 import logging
+import threading
 import time
 from dataclasses import dataclass
 
 from tempo_tpu.encoding.common import SearchRequest, SearchResponse, TraceSearchMetadata
 from tempo_tpu.model.trace import combine_traces
 from tempo_tpu.modules.worker import JobBroker, decode_trace_result
-from tempo_tpu.util import metrics
+from tempo_tpu.util import metrics, resource
 
 log = logging.getLogger(__name__)
 
@@ -30,6 +32,11 @@ partial_results_total = metrics.counter(
     "tempo_query_frontend_partial_results_total",
     "Queries answered with status=partial (terminal shard failures "
     "within the tenant's failed-shard budget)",
+)
+query_cost_hist = metrics.histogram(
+    "tempo_query_frontend_estimated_bytes",
+    "Per-query bytes-to-scan estimate from the block index",
+    buckets=(1e6, 1e7, 1e8, 5e8, 1e9, 5e9, 1e10, 5e10),
 )
 
 
@@ -64,11 +71,22 @@ class FrontendConfig:
     # 0 preserves strict all-or-nothing semantics. Per-tenant override:
     # overrides.Limits.query_partial_shard_fraction (>= 0 wins).
     max_failed_shard_fraction: float = 0.0
+    # -- admission / shedding -------------------------------------------
+    # concurrent queries one tenant may hold (0 = unlimited); per-tenant
+    # override: overrides.Limits.max_concurrent_queries (> 0 wins).
+    # Excess is SHED with a retry hint, never queued — a queue of
+    # already-over-cap work only grows the backlog.
+    max_concurrent_queries: int = 0
+    # under memory pressure, historical scans whose bytes-to-scan
+    # estimate (from the block index) exceeds this are shed FIRST;
+    # live-tail and recent-window queries keep flowing until the
+    # inflight-bytes pool itself is full. 0 disables the class split.
+    shed_historical_above_bytes: int = 1 << 30
 
 
 class Frontend:
     def __init__(self, broker: JobBroker, db, cfg: FrontendConfig | None = None,
-                 overrides=None):
+                 overrides=None, governor: "resource.ResourceGovernor | None" = None):
         """db: blocklist provider (TempoDB reader); the frontend needs
         block metas to shard searches (reference: frontend reads the
         tempodb.Reader blocklist, searchsharding.go:250)."""
@@ -76,6 +94,94 @@ class Frontend:
         self.db = db
         self.cfg = cfg or FrontendConfig()
         self.overrides = overrides
+        self.governor = governor or resource.governor()
+        self._adm_lock = threading.Lock()
+        self._tenant_inflight: dict[str, int] = {}
+
+    # ------------------------------------------------------------------
+    # admission: every query passes here BEFORE any job is sharded.
+    # Cost is estimated from the block index (bytes-to-scan = the sizes
+    # of the blocks the sharders would touch), the cheap proxy the
+    # reference frontend uses for its own query-size limits. Shedding
+    # priority under pressure: large HISTORICAL scans go first; live-tail
+    # / recent-window / trace-by-ID queries keep flowing until the
+    # inflight-bytes pool itself is full or the tenant cap is hit.
+    def _concurrency_cap(self, tenant: str) -> int:
+        cap = self.cfg.max_concurrent_queries
+        if self.overrides is not None:
+            t_cap = self.overrides.for_tenant(tenant).max_concurrent_queries
+            if t_cap > 0:
+                cap = t_cap
+        return cap
+
+    @contextlib.contextmanager
+    def _admit(self, tenant: str, est_bytes: int, protected: bool, what: str):
+        est_bytes = max(0, int(est_bytes))
+        query_cost_hist.observe(est_bytes, kind=what)
+        # the pool bounds RESIDENT bytes, and execution is chunked: at
+        # most ~query_shards jobs of target_bytes_per_job are in flight
+        # per query, however large the total scan. Charge admission with
+        # that resident ceiling; the full est_bytes still classifies the
+        # query for historical-scan shedding below.
+        resident_cap = max(
+            1, self.cfg.target_bytes_per_job * max(1, self.cfg.query_shards))
+        charge = min(est_bytes, resident_cap)
+        cap = self._concurrency_cap(tenant)
+        with self._adm_lock:
+            cur = self._tenant_inflight.get(tenant, 0)
+            if cap and cur >= cap:
+                resource.shed_total.inc(component="frontend", reason="tenant_concurrency")
+                raise resource.ResourceExhausted(
+                    f"tenant {tenant}: {cur} queries in flight (cap {cap}); "
+                    "shed, retry shortly",
+                    retry_after_s=self.governor.retry_after_s(),
+                )
+            self._tenant_inflight[tenant] = cur + 1
+        pool = self.governor.pool("inflight_query")
+        try:
+            if pool.limit and charge > pool.limit:
+                # retrying can never help — the query's resident demand
+                # alone exceeds the whole budget. Terminal client error
+                # (same contract as max_search_duration), NOT a retryable
+                # shed: a 429 with a hint here would livelock clients.
+                raise ValueError(
+                    f"{what} needs ~{max(1, charge >> 20)} MiB resident, over "
+                    f"the per-process inflight budget "
+                    f"({pool.limit / (1 << 20):g} MiB); narrow the time "
+                    "range or filter"
+                )
+            if not pool.try_add(charge):
+                resource.shed_total.inc(component="frontend", reason="inflight_query_full")
+                raise resource.ResourceExhausted(
+                    f"frontend: inflight query bytes over budget "
+                    f"({pool.used}/{pool.limit}); {what} shed",
+                    retry_after_s=self.governor.retry_after_s(),
+                )
+            try:
+                if (
+                    not protected
+                    and self.cfg.shed_historical_above_bytes
+                    and est_bytes > self.cfg.shed_historical_above_bytes
+                    and self.governor.level() >= resource.LEVEL_PRESSURE
+                ):
+                    resource.shed_total.inc(component="frontend", reason="historical_scan")
+                    raise resource.ResourceExhausted(
+                        f"frontend: shedding large historical {what} "
+                        f"(~{est_bytes >> 20} MiB to scan) under memory pressure",
+                        retry_after_s=self.governor.retry_after_s() * 2,
+                    )
+                yield
+            finally:
+                pool.sub(charge)
+        finally:
+            with self._adm_lock:
+                left = self._tenant_inflight.get(tenant, 1) - 1
+                if left <= 0:
+                    # remove at zero: churned tenant IDs must not pin
+                    # dict entries forever
+                    self._tenant_inflight.pop(tenant, None)
+                else:
+                    self._tenant_inflight[tenant] = left
 
     # ------------------------------------------------------------------
     # error-type prefixes that are ALWAYS query-fatal (a malformed query
@@ -101,9 +207,22 @@ class Frontend:
         exceeded deadline is terminal, not retried."""
         from tempo_tpu.modules.worker import JobError
 
+        from tempo_tpu.modules.queue import TooManyRequests
+
         deadline_ts = time.time() + self.cfg.job_timeout_s
         descs = [{**d, "deadline": deadline_ts} for d in descs]
-        groups = [[self.broker.submit(tenant, d)] for d in descs]
+        groups = []
+        try:
+            for d in descs:
+                groups.append([self.broker.submit(tenant, d)])
+        except TooManyRequests:
+            # the query is failing 429 — jobs already queued must not
+            # keep executing with no waiter (wasted scans exactly while
+            # the system sheds for overload). Expiring their deadline
+            # makes the broker drop them unexecuted at pull.
+            for grp in groups:
+                grp[0].desc["deadline"] = time.time() - 1
+            raise
         results: list = []
         terminal_errors: list = []  # never retried, never lost
         for attempt in range(self.cfg.max_retries + 1):
@@ -138,7 +257,16 @@ class Frontend:
                 "retrying %d failed query jobs (attempt %d/%d)",
                 len(failed), attempt + 1, self.cfg.max_retries,
             )
-            groups = [[self.broker.submit(tenant, grp[0].desc)] for grp in failed]
+            # resubmission gets the same queue-full cleanup as the
+            # initial submit: orphaned retries must not execute waiterless
+            groups = []
+            try:
+                for grp in failed:
+                    groups.append([self.broker.submit(tenant, grp[0].desc)])
+            except TooManyRequests:
+                for g in groups:
+                    g[0].desc["deadline"] = time.time() - 1
+                raise
         return results, terminal_errors
 
     def _settle(self, tenant: str, n_shards: int, results: list, errors: list) -> int:
@@ -226,7 +354,10 @@ class Frontend:
                     "block_end": bounds[i + 1],
                 }
             )
-        results, errors = self._run_jobs(tenant, descs)
+        # trace-by-ID is bloom-pruned point work, the protected class:
+        # zero-byte estimate = only the tenant concurrency cap applies
+        with self._admit(tenant, 0, protected=True, what="find"):
+            results, errors = self._run_jobs(tenant, descs)
         if errors:
             # a failed shard could hide spans of this trace; fail the whole
             # query rather than return a silently incomplete trace
@@ -247,25 +378,34 @@ class Frontend:
         now = time.time()
         descs = []
         ing_cutoff = now - self.cfg.query_ingesters_until_s
-        if not req.end_seconds or req.end_seconds >= ing_cutoff:
+        recent = bool(not req.end_seconds or req.end_seconds >= ing_cutoff)
+        if recent:
             descs.append({"kind": "search_recent", "search": req.to_dict()})
+        # the PROTECTED class is queries confined to the recent window
+        # (live tail, "last 5 minutes" dashboards). Touching `now` is
+        # not enough: an open-ended scan over all history also touches
+        # now, and it is exactly the large scan pressure must shed first.
+        protected = bool(req.start_seconds and req.start_seconds >= ing_cutoff)
 
         metas = [
             m for m in self.db.blocklist.metas(tenant)
             if (not req.start_seconds or m.end_time >= req.start_seconds)
             and (not req.end_seconds or m.start_time <= req.end_seconds)
         ]
+        est_bytes = 0
         group, size = [], 0
         for m in metas:
             group.append(m.block_id)
             size += max(m.size_bytes, 1)
+            est_bytes += max(m.size_bytes, 1)
             if size >= self.cfg.target_bytes_per_job:
                 descs.append({"kind": "search_blocks", "block_ids": group, "search": req.to_dict()})
                 group, size = [], 0
         if group:
             descs.append({"kind": "search_blocks", "block_ids": group, "search": req.to_dict()})
 
-        results, errors = self._run_jobs(tenant, descs)
+        with self._admit(tenant, est_bytes, protected=protected, what="search"):
+            results, errors = self._run_jobs(tenant, descs)
         failed = self._settle(tenant, len(descs), results, errors)
         out = SearchResponse()
         for r in results:
@@ -310,7 +450,8 @@ class Frontend:
 
         descs = []
         now = time.time()
-        if plan.end_s >= now - self.cfg.query_ingesters_until_s:
+        recent = plan.end_s >= now - self.cfg.query_ingesters_until_s
+        if recent:
             descs.append({"kind": "metrics_recent", "start": plan.start_s,
                           "end": plan.end_s, **common})
 
@@ -319,6 +460,7 @@ class Frontend:
         n_shards = max(1, min(self.cfg.query_shards, plan.n_bins))
         bins_per = -(-plan.n_bins // n_shards)  # ceil
         metas = self.db.blocklist.metas(tenant)
+        est_bytes = 0
         b = 0
         while b < plan.n_bins:
             w0 = plan.start_s + b * plan.step_s
@@ -330,6 +472,7 @@ class Frontend:
                     continue
                 group.append(m.block_id)
                 size += max(m.size_bytes, 1)
+                est_bytes += max(m.size_bytes, 1)
                 if size >= self.cfg.target_bytes_per_job:
                     descs.append({"kind": "metrics_blocks", "block_ids": group,
                                   "start": w0, "end": w1, **common})
@@ -338,7 +481,11 @@ class Frontend:
                 descs.append({"kind": "metrics_blocks", "block_ids": group,
                               "start": w0, "end": w1, **common})
 
-        results, errors = self._run_jobs(tenant, descs)
+        # protected = the whole range sits in the recent window (same
+        # rule as search: touching `now` alone doesn't protect a scan)
+        protected = plan.start_s >= now - self.cfg.query_ingesters_until_s
+        with self._admit(tenant, est_bytes, protected=protected, what="query_range"):
+            results, errors = self._run_jobs(tenant, descs)
         # a failed shard is a hole in the range vector: NEVER silently
         # wrong rates — either fail the query (over budget) or flag the
         # response partial with an exact failed-shard count
@@ -371,10 +518,24 @@ class Frontend:
         from tempo_tpu.traceql import parse
 
         parse(query)
-        results, errors = self._run_jobs(
-            tenant,
-            [{"kind": "traceql", "q": query, "start": start_s, "end": end_s, "limit": limit}],
+        # cost estimate: every block overlapping the window (the traceql
+        # job scans recent data + blocks itself); no window = everything
+        metas = [
+            m for m in self.db.blocklist.metas(tenant)
+            if (not start_s or m.end_time >= start_s)
+            and (not end_s or m.start_time <= end_s)
+        ]
+        est_bytes = sum(max(m.size_bytes, 1) for m in metas)
+        # protected only when confined to the recent window (see search)
+        protected = bool(
+            start_s and start_s >= time.time() - self.cfg.query_ingesters_until_s
         )
+        with self._admit(tenant, est_bytes, protected=protected, what="traceql"):
+            results, errors = self._run_jobs(
+                tenant,
+                [{"kind": "traceql", "q": query, "start": start_s, "end": end_s,
+                  "limit": limit}],
+            )
         if errors and not results:
             raise errors[0]
         out = []

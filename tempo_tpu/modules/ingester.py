@@ -23,7 +23,7 @@ import numpy as np
 from tempo_tpu.encoding.vtpu import format as fmt
 from tempo_tpu.model.columnar import SpanBatch
 from tempo_tpu.model.trace import Trace, batch_to_traces, combine_traces
-from tempo_tpu.util import metrics
+from tempo_tpu.util import metrics, resource
 from tempo_tpu.util.flushqueues import ExclusiveQueues, FlushOp
 
 log = logging.getLogger(__name__)
@@ -37,6 +37,14 @@ blocks_dropped_metric = metrics.counter(
 )
 live_traces_gauge = metrics.gauge(
     "tempo_ingester_live_traces", "Live traces currently held, per tenant"
+)
+early_cuts_total = metrics.counter(
+    "tempo_ingester_pressure_cuts_total",
+    "Sweeps that cut/flushed early because of memory pressure",
+)
+pushes_refused_total = metrics.counter(
+    "tempo_ingester_pushes_refused_total",
+    "Pushes refused at critical memory pressure (retryable)",
 )
 
 
@@ -72,11 +80,13 @@ class IngesterConfig:
 
 
 class TenantInstance:
-    def __init__(self, tenant: str, db, overrides, cfg: IngesterConfig):
+    def __init__(self, tenant: str, db, overrides, cfg: IngesterConfig,
+                 governor: "resource.ResourceGovernor | None" = None):
         self.tenant = tenant
         self.db = db
         self.overrides = overrides
         self.cfg = cfg
+        self.governor = governor or resource.governor()
         self.lock = threading.Lock()
         self.live: dict[bytes, LiveTrace] = {}
         self.head = db.wal.new_block(tenant)
@@ -102,6 +112,7 @@ class TenantInstance:
         tid = batch.cols["trace_id"]
         uniq, inverse = np.unique(tid, axis=0, return_inverse=True)
         errors: list[Exception] = []
+        appended_bytes = 0
         with self.lock:
             for u in range(len(uniq)):
                 rows = np.flatnonzero(inverse == u)
@@ -133,7 +144,15 @@ class TenantInstance:
                 lt.span_count += sub.num_spans
                 lt.byte_count += sub.nbytes()
                 lt.last_touch = now
+                appended_bytes += sub.nbytes()
             live_traces_gauge.set(len(self.live), tenant=self.tenant)
+            # charge the pool UNDER the instance lock: a concurrent cut
+            # can only sub bytes it saw in self.live, and those are
+            # visible only after this lock releases — so the matching
+            # add always lands first and the sub clamp never discards a
+            # deficit that a late add would then leak forever
+            if appended_bytes:
+                self.governor.pool("live_traces").add(appended_bytes)
         if errors:
             raise errors[0]
 
@@ -150,14 +169,32 @@ class TenantInstance:
         live_traces_gauge.set(len(self.live), tenant=self.tenant)
         if not cut:
             return 0
+        cut_bytes = sum(lt.byte_count for _, lt in cut)
         batch = SpanBatch.concat([seg for _, lt in cut for seg in lt.segments]).sorted_by_trace()
         # append under the lock: cut_block_if_ready swaps self.head into
         # completing under it, and a completing block may already be mid
         # write_wal_block/clear() — an unlocked append can land on a block
         # that is then cleared, silently losing the cut traces (caught by
         # tests/test_race_stress.py::test_concurrent_push_cut_flush_search)
-        with self.lock:
-            self.head.append(batch)
+        # accounting: the traces left self.live above, so the live pool
+        # gives the bytes back even if the append below fails (a failed
+        # append loses the cut — PR-6 territory — and leaked accounting
+        # would ratchet phantom pressure until pushes are refused).
+        # The wal_head pool is charged BEFORE _gov_bytes is bumped: a
+        # concurrent complete/drop releasing _gov_bytes must never sub
+        # bytes whose matching add hasn't landed (Pool.sub clamps at 0,
+        # so a premature sub would silently discard the deficit and the
+        # later add would leak forever).
+        self.governor.pool("live_traces").sub(cut_bytes)
+        wal_pool = self.governor.pool("wal_head")
+        wal_pool.add(cut_bytes)
+        try:
+            with self.lock:
+                self.head.append(batch)
+                self.head._gov_bytes = getattr(self.head, "_gov_bytes", 0) + cut_bytes
+        except BaseException:
+            wal_pool.sub(cut_bytes)  # append failed: nothing to account
+            raise
         return len(cut)
 
     def cut_block_if_ready(self, now: float | None = None, immediate: bool = False):
@@ -207,9 +244,21 @@ class TenantInstance:
             if meta is not None:
                 self.flushed.append((meta, now))
         blk.clear()
+        self._release_block_accounting(blk)
         if meta is not None:
             blocks_flushed.inc(tenant=self.tenant)
         return meta
+
+    def _release_block_accounting(self, blk) -> None:
+        # read-and-zero under the instance lock: two releasers racing
+        # (a >5s-stuck flush worker vs the shutdown drain) would both
+        # read the same _gov_bytes and double-sub the PROCESS-wide pool,
+        # erasing bytes other instances legitimately accounted
+        with self.lock:
+            n = getattr(blk, "_gov_bytes", 0)
+            blk._gov_bytes = 0
+        if n:
+            self.governor.pool("wal_head").sub(n)
 
     def drop_block(self, blk) -> None:
         """Data-loss cap: after max_complete_attempts the block is
@@ -224,6 +273,7 @@ class TenantInstance:
             self._inflight.discard(blk.block_id)
             if blk in self.completing:
                 self.completing.remove(blk)
+        self._release_block_accounting(blk)
         try:
             blk.clear()
         except Exception:
@@ -254,6 +304,26 @@ class TenantInstance:
                 (m, at) for m, at in self.flushed if now - at < self.cfg.complete_block_timeout_s
             ]
             return before - len(self.flushed)
+
+    def release_accounting(self) -> None:
+        """Shutdown hygiene: give back every byte this instance accounted
+        to the process pools (the governor outlives the ingester — tests
+        build many apps per process and leaked accounting would read as
+        phantom pressure)."""
+        with self.lock:
+            # once-only for the live share: a double stop() (or a stop
+            # racing a late sweep) must not sub the process-wide pool
+            # twice — the clamp would silently erase other instances'
+            # bytes (same hazard _release_block_accounting zeroes
+            # _gov_bytes against)
+            released = getattr(self, "_live_released", False)
+            self._live_released = True
+            live = 0 if released else sum(lt.byte_count for lt in self.live.values())
+            blocks = [self.head] + list(self.completing)
+        if live:
+            self.governor.pool("live_traces").sub(live)
+        for blk in blocks:
+            self._release_block_accounting(blk)
 
     # -- queries over not-yet-backend state ------------------------------
     def find_trace_by_id(self, trace_id: bytes) -> Trace | None:
@@ -286,11 +356,13 @@ class TenantInstance:
 
 class Ingester:
     def __init__(self, db, overrides, cfg: IngesterConfig | None = None,
-                 instance_id: str = "ingester-0"):
+                 instance_id: str = "ingester-0",
+                 governor: "resource.ResourceGovernor | None" = None):
         self.db = db
         self.overrides = overrides
         self.cfg = cfg or IngesterConfig()
         self.instance_id = instance_id
+        self.governor = governor or resource.governor()
         self.instances: dict[str, TenantInstance] = {}
         self.lock = threading.Lock()
         self._stop = threading.Event()
@@ -304,12 +376,22 @@ class Ingester:
         with self.lock:
             inst = self.instances.get(tenant)
             if inst is None:
-                inst = TenantInstance(tenant, self.db, self.overrides, self.cfg)
+                inst = TenantInstance(tenant, self.db, self.overrides, self.cfg,
+                                      governor=self.governor)
                 self.instances[tenant] = inst
             return inst
 
     # -- rpc surface -----------------------------------------------------
     def push_segment(self, tenant: str, data: bytes) -> None:
+        # the hard watermark: live-trace/WAL-head pools (or RSS) over the
+        # hard fraction -> refuse with a RETRYABLE ResourceExhausted that
+        # carries a retry hint. The distributor surfaces it as
+        # 429 + Retry-After; nothing is acknowledged, so nothing is lost.
+        try:
+            self.governor.check_critical("ingester", f"push for tenant {tenant}")
+        except resource.ResourceExhausted:
+            pushes_refused_total.inc(tenant=tenant)
+            raise
         self.instance(tenant).push_segment(data)
 
     def find_trace_by_id(self, tenant: str, trace_id: bytes) -> Trace | None:
@@ -337,12 +419,26 @@ class Ingester:
         sweepAllInstances flush.go:144). immediate=True is the
         deterministic path: cuts everything and drains synchronously.
         The background loop instead enqueues flush ops serviced by the
-        flush-queue workers (dedupe by block, retry with backoff)."""
+        flush-queue workers (dedupe by block, retry with backoff).
+
+        At the SOFT watermark the sweep turns aggressive across every
+        tenant: idle-timeout cuts become immediate cuts, head blocks cut
+        regardless of age/size, and the flush queues drain them — memory
+        moves to the backend early instead of waiting for the idle
+        window while pressure builds toward the hard (refuse) line."""
+        under_pressure = self.governor.level() >= resource.LEVEL_PRESSURE
+        if under_pressure:
+            early_cuts_total.inc()
+            log.warning(
+                "ingester sweep cutting early: pressure level %s (%s)",
+                self.governor.level_name(), self.governor.describe(),
+            )
+        cut_now = immediate or under_pressure
         with self.lock:
             instances = list(self.instances.values())
         for inst in instances:
-            inst.cut_complete_traces(immediate=immediate)
-            inst.cut_block_if_ready(immediate=immediate)
+            inst.cut_complete_traces(immediate=cut_now)
+            inst.cut_block_if_ready(immediate=cut_now)
             if immediate or not self._flush_threads:
                 inst.complete_and_flush()
             else:
@@ -424,3 +520,7 @@ class Ingester:
         self._flush_threads = []
         if flush:
             self.flush_all()
+        with self.lock:
+            instances = list(self.instances.values())
+        for inst in instances:
+            inst.release_accounting()

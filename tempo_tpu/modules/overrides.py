@@ -34,6 +34,10 @@ class Limits:
     max_bytes_per_tag_values_query: int = 5 * 1024 * 1024
     max_search_duration_s: int = 0  # 0 = unlimited
     max_queriers_per_tenant: int = 0  # query shuffle-sharding
+    # admission: concurrent queries this tenant may hold in the frontend
+    # (0 = inherit FrontendConfig.max_concurrent_queries; the excess is
+    # shed with 429 + Retry-After, not queued)
+    max_concurrent_queries: int = 0
     # graceful degradation: fraction of a query's shards allowed to fail
     # terminally before the whole query fails — within budget the
     # frontend returns status="partial" with a failed-shard count.

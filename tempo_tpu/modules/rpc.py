@@ -110,13 +110,28 @@ class RemoteIngester:
         self.client = PooledHTTPClient(base_url, timeout_s=timeout_s, max_retries=1)
 
     def push_segment(self, tenant: str, data: bytes) -> None:
-        self.client.request(
-            "POST",
-            "/rpc/v1/ingester/push",
-            headers={"X-Scope-OrgID": tenant, "Content-Type": "application/octet-stream"},
-            body=data,
-            ok=(200,),
-        )
+        from tempo_tpu.backend.httpclient import HTTPError
+        from tempo_tpu.util.resource import ResourceExhausted
+
+        try:
+            self.client.request(
+                "POST",
+                "/rpc/v1/ingester/push",
+                headers={"X-Scope-OrgID": tenant, "Content-Type": "application/octet-stream"},
+                body=data,
+                ok=(200,),
+            )
+        except HTTPError as e:
+            if e.status == 429:
+                # the remote ingester shed under pressure: re-raise as the
+                # typed backpressure error (with its Retry-After hint) so
+                # the distributor's quorum logic treats it as overload,
+                # not an outage
+                raise ResourceExhausted(
+                    f"ingester {self.base_url} shed push: {e}",
+                    retry_after_s=e.parse_retry_after() or 1.0,
+                ) from e
+            raise
 
     def find_trace_by_id(self, tenant: str, trace_id: bytes):
         from tempo_tpu.backend.httpclient import HTTPError

@@ -29,12 +29,30 @@ import itertools
 import logging
 import threading
 import time
+import weakref
 
 from tempo_tpu.encoding.common import SearchRequest, SearchResponse
 from tempo_tpu.modules.queue import RequestQueue
-from tempo_tpu.util import deadline
+from tempo_tpu.util import deadline, metrics
 
 log = logging.getLogger(__name__)
+
+jobs_expired_total = metrics.counter(
+    "tempo_query_frontend_jobs_expired_total",
+    "Jobs dropped at dequeue because their deadline elapsed while queued "
+    "(dead work is never executed)",
+)
+queue_depth_gauge = metrics.gauge(
+    "tempo_query_frontend_queue_depth", "Queued jobs across live brokers"
+)
+queue_age_gauge = metrics.gauge(
+    "tempo_query_frontend_queue_age_seconds",
+    "Age of the oldest queued job across live brokers",
+)
+queue_tenants_gauge = metrics.gauge(
+    "tempo_query_frontend_queue_tenants",
+    "Tenants currently holding queued jobs (pruned on drain)",
+)
 
 
 # -- executing a descriptor on a querier ---------------------------------
@@ -104,6 +122,36 @@ class JobError(Exception):
     pass
 
 
+# one process-wide collector over every live broker (tests build many;
+# a per-instance collector each would pile up in the registry forever)
+_live_brokers: "weakref.WeakSet" = weakref.WeakSet()
+_brokers_lock = threading.Lock()
+_collector_registered = False
+
+
+def _register_broker(broker) -> None:
+    global _collector_registered
+    with _brokers_lock:
+        _live_brokers.add(broker)
+        if _collector_registered:
+            return
+        _collector_registered = True
+
+    def collect():
+        with _brokers_lock:
+            brokers = list(_live_brokers)
+        depth = age = tenants = 0
+        for b in brokers:
+            depth += b.queue.depth()
+            tenants += b.queue.tenant_count()
+            age = max(age, b.queue.oldest_age_s())
+        queue_depth_gauge.set(depth)
+        queue_age_gauge.set(age)
+        queue_tenants_gauge.set(tenants)
+
+    metrics.register_collector(collect)
+
+
 class _Pending:
     __slots__ = ("job_id", "tenant", "desc", "event", "result", "error", "deadline")
 
@@ -128,6 +176,8 @@ class JobBroker:
         self._ids = itertools.count(1)
         self._inflight: dict[str, _Pending] = {}
         self._lock = threading.Lock()
+        self.expired = 0
+        _register_broker(self)
 
     def submit(self, tenant: str, desc: dict) -> _Pending:
         p = _Pending(f"job-{next(self._ids)}", tenant, desc)
@@ -136,16 +186,38 @@ class JobBroker:
 
     def pull(self, timeout: float = 10.0):
         """Next due job -> (job_id, tenant, desc) or None. Also reaps
-        expired leases back into the queue."""
+        expired leases back into the queue, and DROPS jobs whose
+        deadline elapsed while they sat queued: the requester already
+        gave up, so executing them is pure amplification — the waiter
+        gets a terminal DeadlineExceeded instead (reference: the
+        scheduler discards requests whose frontend context expired)."""
         self._reap()
-        item = self.queue.dequeue(timeout=timeout)
-        if item is None:
-            return None
-        _, p = item
+        end = time.monotonic() + timeout
+        while True:
+            item = self.queue.dequeue(timeout=max(0.0, end - time.monotonic()))
+            if item is None:
+                return None
+            _, p = item
+            dl = p.desc.get("deadline")
+            if dl and dl <= time.time():
+                self.expired += 1
+                jobs_expired_total.inc()
+                p.error = (
+                    f"DeadlineExceeded: job {p.job_id} expired in queue "
+                    f"({time.time() - dl:.2f}s past deadline); dropped unexecuted"
+                )
+                p.event.set()
+                if time.monotonic() >= end:
+                    return None
+                continue
+            with self._lock:
+                p.deadline = time.monotonic() + self.lease_s
+                self._inflight[p.job_id] = p
+            return p.job_id, p.tenant, p.desc
+
+    def inflight_count(self) -> int:
         with self._lock:
-            p.deadline = time.monotonic() + self.lease_s
-            self._inflight[p.job_id] = p
-        return p.job_id, p.tenant, p.desc
+            return len(self._inflight)
 
     def complete(self, job_id: str, result: dict | None = None, error: str | None = None) -> bool:
         with self._lock:
@@ -188,11 +260,16 @@ class LocalWorkerPool:
     """
 
     def __init__(self, broker: JobBroker, querier, n_workers: int = 4,
-                 max_retries: int = 2, retry_backoff_s: float = 0.05):
+                 max_retries: int = 2, retry_backoff_s: float = 0.05,
+                 breaker=None):
         self.broker = broker
         self.querier = querier
         self.max_retries = max_retries
         self.retry_backoff_s = retry_backoff_s
+        # shared CircuitBreaker (util/circuit): when the backend is down
+        # for everyone, attempts fail fast locally instead of hammering
+        # it with n_workers * max_retries concurrent retry loops
+        self.breaker = breaker
         self._stop = threading.Event()
         self.threads = [
             threading.Thread(target=self._run, daemon=True, name=f"query-worker-{i}")
@@ -211,13 +288,23 @@ class LocalWorkerPool:
             last: Exception | None = None
             for attempt in range(self.max_retries + 1):
                 try:
+                    if self.breaker is not None:
+                        return self.breaker.run(
+                            lambda: execute_job(self.querier, tenant, desc)
+                        )
                     return execute_job(self.querier, tenant, desc)
                 except Exception as e:  # noqa: BLE001 — classified below
                     if not retryable_error(e) or attempt == self.max_retries:
                         raise
                     last = e
-                    self._stop.wait(deadline.bound_timeout(
-                        min(self.retry_backoff_s * (2 ** attempt), 1.0)))
+                    # shed/breaker errors carry a pacing hint; honor it
+                    # in full — clipped only by the job's remaining
+                    # deadline, never by the exponential-backoff cap
+                    # (re-probing an open breaker faster than its reset
+                    # window asked for defeats the pacing)
+                    backoff = min(self.retry_backoff_s * (2 ** attempt), 1.0)
+                    backoff = max(backoff, getattr(e, "retry_after_s", 0.0))
+                    self._stop.wait(deadline.bound_timeout(backoff))
                     deadline.check()
             raise last  # pragma: no cover — loop always returns or raises
 
@@ -247,12 +334,13 @@ class RemoteWorker:
     DNS-discovers frontends and opens Process streams)."""
 
     def __init__(self, frontend_url: str, querier, n_threads: int = 2,
-                 result_post_retries: int = 2):
+                 result_post_retries: int = 2, breaker=None):
         from tempo_tpu.backend.httpclient import PooledHTTPClient
 
         self.client = PooledHTTPClient(frontend_url, timeout_s=30.0, max_retries=0)
         self.querier = querier
         self.result_post_retries = result_post_retries
+        self.breaker = breaker  # shared CircuitBreaker; see LocalWorkerPool
         self._stop = threading.Event()
         self.threads = [
             threading.Thread(target=self._run, daemon=True, name=f"remote-worker-{i}")
@@ -277,7 +365,13 @@ class RemoteWorker:
                 job = json.loads(body)
                 job_id, tenant, desc = job["job_id"], job["tenant"], job["desc"]
                 try:
-                    out = {"result": execute_job(self.querier, tenant, desc)}
+                    if self.breaker is not None:
+                        result = self.breaker.run(
+                            lambda: execute_job(self.querier, tenant, desc)
+                        )
+                    else:
+                        result = execute_job(self.querier, tenant, desc)
+                    out = {"result": result}
                 except Exception as e:  # noqa: BLE001
                     out = {"error": f"{type(e).__name__}: {e}"}
                 self._post_result(job_id, json.dumps(out).encode())

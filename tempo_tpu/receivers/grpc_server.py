@@ -34,6 +34,60 @@ DEFAULT_GRPC_PORT = 4317  # reference: the OTLP collector default
 
 _ORG_ID_KEYS = ("x-scope-orgid",)
 
+RETRY_INFO_TYPE_URL = "type.googleapis.com/google.rpc.RetryInfo"
+GRPC_RESOURCE_EXHAUSTED = 8  # google.rpc.Code.RESOURCE_EXHAUSTED
+
+
+def encode_retry_status(code: int, message: str, retry_after_s: float) -> bytes:
+    """google.rpc.Status{code, message, details=[RetryInfo{retry_delay}]}
+    hand-rolled with this repo's proto wire codec — the standard payload
+    gRPC clients read from the grpc-status-details-bin trailer to pace
+    their retries (the reference's RESOURCE_EXHAUSTED pushes carry the
+    same detail via dskit)."""
+    seconds = int(retry_after_s)
+    nanos = int((retry_after_s - seconds) * 1e9)
+    duration = bytearray()
+    if seconds:
+        protowire.put_varint_field(duration, 1, seconds)
+    if nanos:
+        protowire.put_varint_field(duration, 2, nanos)
+    retry_info = bytearray()
+    protowire.put_bytes_field(retry_info, 1, bytes(duration))
+    any_msg = bytearray()
+    protowire.put_str_field(any_msg, 1, RETRY_INFO_TYPE_URL)
+    protowire.put_bytes_field(any_msg, 2, bytes(retry_info))
+    status = bytearray()
+    protowire.put_varint_field(status, 1, code)
+    protowire.put_str_field(status, 2, message)
+    protowire.put_bytes_field(status, 3, bytes(any_msg))
+    return bytes(status)
+
+
+def decode_retry_info_delay(status_bytes: bytes) -> float | None:
+    """Inverse of encode_retry_status for tests/clients: the RetryInfo
+    retry_delay in seconds, or None when the Status has no such detail."""
+    for field, _, val in protowire.iter_fields(status_bytes):
+        if field != 3:
+            continue
+        type_url, value = "", b""
+        for f2, _, v2 in protowire.iter_fields(val):
+            if f2 == 1:
+                type_url = v2.decode("utf-8", "replace")
+            elif f2 == 2:
+                value = v2
+        if type_url != RETRY_INFO_TYPE_URL:
+            continue
+        for f2, _, v2 in protowire.iter_fields(value):
+            if f2 == 1:
+                seconds = nanos = 0
+                for f3, _, v3 in protowire.iter_fields(v2):
+                    if f3 == 1:
+                        seconds = v3
+                    elif f3 == 2:
+                        nanos = v3
+                return seconds + nanos / 1e9
+    return None
+
 
 # ---------------------------------------------------------------------------
 # Jaeger api_v2 proto decoding (model.proto)
@@ -194,12 +248,26 @@ class TraceGrpcServer:
 
     def _ingest(self, traces, context):
         from tempo_tpu.modules.distributor import RateLimited
+        from tempo_tpu.util.resource import ResourceExhausted
 
         try:
             self._push(traces, org_id=self._org_id(context))
-        except RateLimited as e:
-            # the gRPC analog of the HTTP 429 translation
+        except (RateLimited, ResourceExhausted) as e:
+            # the gRPC analog of the HTTP 429 + Retry-After translation:
+            # RESOURCE_EXHAUSTED with a RetryInfo detail in the standard
+            # grpc-status-details-bin trailer (plus a plain-text
+            # retry-delay-ms for clients without Status decoding)
+            delay = max(0.001, getattr(e, "retry_after_s", 1.0))
+            context.set_trailing_metadata((
+                ("retry-delay-ms", str(int(delay * 1000))),
+                ("grpc-status-details-bin",
+                 encode_retry_status(GRPC_RESOURCE_EXHAUSTED, str(e), delay)),
+            ))
             context.abort(self._grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        except ValueError as e:
+            # never-admissible request (e.g. one batch over the whole
+            # inflight budget): the caller's error, not a server fault
+            context.abort(self._grpc.StatusCode.INVALID_ARGUMENT, str(e))
         except Exception as e:
             log.exception("grpc ingest failed")
             context.abort(self._grpc.StatusCode.INTERNAL, str(e))

@@ -119,6 +119,16 @@ def _item_nbytes(item) -> int:
     return getattr(item, "nbytes", len(item) if isinstance(item, (bytes, bytearray)) else 0)
 
 
+def _under_pressure() -> bool:
+    """Prefetch gate: lookahead trades memory for latency, exactly the
+    wrong trade while the process is under memory pressure — new
+    ReadAhead instances run without the background slot until the
+    governor (util/resource) reports OK again."""
+    from tempo_tpu.util import resource
+
+    return resource.governor().level() >= resource.LEVEL_PRESSURE
+
+
 class ReadAhead:
     """One-slot lookahead for a pull-based loader: while the consumer
     works on item i, a worker thread loads item i+1.
@@ -145,7 +155,7 @@ class ReadAhead:
         self._future = None
         self._pool = (
             ThreadPoolExecutor(max_workers=1)
-            if n_items > 1 and overlap_enabled()
+            if n_items > 1 and overlap_enabled() and not _under_pressure()
             else None
         )
         self._register_metrics()

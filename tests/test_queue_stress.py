@@ -1,0 +1,183 @@
+"""RequestQueue fairness/starvation/churn stress.
+
+Satellite of the PR-8 overload control plane: one heavy tenant flooding
+the queue, trickle tenants submitting occasionally, and churn tenants
+appearing/draining continuously. Asserts the three properties the
+round-robin + pruning design promises:
+
+- no starvation: every trickle job is served despite the flood,
+- bounded wait: a trickle job never waits more than ~one rotation of
+  the active tenant set behind the heavy tenant's backlog,
+- bounded state: after the churn, `_queues`/`_rr` hold only tenants
+  with queued jobs (the pre-PR-8 implementation grew them forever and
+  scanned every dead tenant on each dequeue).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tempo_tpu.modules.queue import RequestQueue, TooManyRequests
+
+
+class TestQueueFairnessStress:
+    def test_heavy_tenant_cannot_starve_trickle_tenants(self):
+        q = RequestQueue(max_per_tenant=10_000)
+        n_heavy = 2_000
+        trickle_tenants = [f"trickle-{i}" for i in range(5)]
+        served: dict[str, list] = {t: [] for t in trickle_tenants}
+        served["heavy"] = []
+        order: list[str] = []
+        stop = threading.Event()
+
+        for i in range(n_heavy):
+            q.enqueue("heavy", ("heavy", i))
+
+        def consumer():
+            while not stop.is_set():
+                item = q.dequeue(timeout=0.05)
+                if item is None:
+                    continue
+                tenant, job = item
+                order.append(tenant)
+                served.setdefault(tenant, []).append(job)
+                time.sleep(0.0002)  # simulate work so producers interleave
+
+        def trickle_producer(tenant: str):
+            for i in range(20):
+                q.enqueue(tenant, (tenant, i))
+                time.sleep(0.002)
+
+        consumers = [threading.Thread(target=consumer, daemon=True) for _ in range(3)]
+        producers = [
+            threading.Thread(target=trickle_producer, args=(t,), daemon=True)
+            for t in trickle_tenants
+        ]
+        for t in consumers:
+            t.start()
+        for t in producers:
+            t.start()
+        for t in producers:
+            t.join(timeout=10)
+
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if all(len(served[t]) == 20 for t in trickle_tenants):
+                break
+            time.sleep(0.05)
+        stop.set()
+        for t in consumers:
+            t.join(timeout=5)
+
+        for t in trickle_tenants:
+            assert len(served[t]) == 20, f"{t} starved: {len(served[t])}/20 served"
+        # bounded wait: round-robin means at most ~|active tenants| heavy
+        # jobs run between two trickle serves. With 6 active tenants and
+        # 3 consumers, a generous bound is 40 heavy serves between
+        # consecutive trickle serves (vs ~2000 for a FIFO queue).
+        heavy_between, worst = 0, 0
+        for tenant in order:
+            if tenant == "heavy":
+                heavy_between += 1
+            else:
+                worst = max(worst, heavy_between)
+                heavy_between = 0
+        assert worst <= 40, f"a trickle job waited behind {worst} heavy jobs"
+        # the heavy backlog kept draining too (no reverse starvation) —
+        # the consumers stop as soon as the trickles finish, so only a
+        # slice of the 2000 heavy jobs runs; it just must not be zero
+        assert len(served["heavy"]) > 20
+
+    def test_tenant_churn_does_not_grow_state(self):
+        """10k one-shot tenants through a live consumer: the tenant maps
+        must end empty, not remember every ID ever seen."""
+        q = RequestQueue(max_per_tenant=10)
+        drained = []
+        stop = threading.Event()
+
+        def consumer():
+            while not stop.is_set():
+                item = q.dequeue(timeout=0.05)
+                if item is not None:
+                    drained.append(item[0])
+
+        threads = [threading.Thread(target=consumer, daemon=True) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for i in range(10_000):
+            q.enqueue(f"churn-{i}", i)
+        deadline = time.monotonic() + 20
+        while len(drained) < 10_000 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert len(drained) == 10_000
+        assert q.tenant_count() == 0
+        assert q._rr == [] and q._queues == {}
+
+    def test_concurrent_churn_with_backpressure(self):
+        """Producers racing consumers under tiny per-tenant caps: no job
+        is lost or duplicated, rejections are the only losses, and the
+        state maps end empty."""
+        q = RequestQueue(max_per_tenant=4)
+        accepted: list = []
+        acc_lock = threading.Lock()
+        drained: list = []
+        drain_lock = threading.Lock()
+        stop = threading.Event()
+
+        def producer(pid: int):
+            for i in range(500):
+                key = (pid, i)
+                try:
+                    q.enqueue(f"tenant-{pid}-{i % 7}", key)
+                except TooManyRequests:
+                    continue
+                with acc_lock:
+                    accepted.append(key)
+
+        def consumer():
+            while not stop.is_set():
+                item = q.dequeue(timeout=0.05)
+                if item is not None:
+                    with drain_lock:
+                        drained.append(item[1])
+
+        consumers = [threading.Thread(target=consumer, daemon=True) for _ in range(3)]
+        producers = [threading.Thread(target=producer, args=(p,), daemon=True)
+                     for p in range(4)]
+        for t in consumers + producers:
+            t.start()
+        for t in producers:
+            t.join(timeout=15)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with acc_lock, drain_lock:
+                if len(drained) >= len(accepted):
+                    break
+            time.sleep(0.02)
+        stop.set()
+        for t in consumers:
+            t.join(timeout=5)
+        assert sorted(drained) == sorted(accepted), "accepted == drained exactly once"
+        assert q.tenant_count() == 0 and q._rr == []
+
+    def test_round_robin_order_preserved_across_prune(self):
+        """Single-threaded determinism: removing a drained tenant must
+        not skip or double-serve the survivors."""
+        q = RequestQueue()
+        for t in ("a", "b", "c"):
+            for i in range(2 if t == "b" else 3):
+                q.enqueue(t, f"{t}{i}")
+        got = []
+        while True:
+            item = q.dequeue(timeout=0.01)
+            if item is None:
+                break
+            got.append(item[1])
+        # rotation a,b,c repeats; b drains after round 2 and the a/c
+        # rotation continues seamlessly
+        assert got == ["a0", "b0", "c0", "a1", "b1", "c1", "a2", "c2"]
+        assert q._rr == []

@@ -1,18 +1,32 @@
-"""Sustained load test against a real multi-process tempo-tpu cluster.
+"""Sustained mixed-workload load test against a real tempo-tpu cluster.
 
 Reference: integration/bench/load_test.go:19 runs k6 against an
 all-in-one deployment with scripted thresholds
 (smoke_test.js:39-45: write success >99%, read success >90%,
-p99 < 1.5s). This is that harness natively: it spawns a cluster of
+p99 < 1.5s). This is that harness natively, grown into the overload
+rig ROADMAP item 5 asked for: it spawns a cluster of
 `python -m tempo_tpu` OS processes (distributor + RF=2 ingesters +
 query-frontend/querier sharing a ring over the netkv control plane),
-sweeps one trace through EVERY ingest protocol (OTLP proto+json,
-Zipkin JSON, Jaeger thrift, and the gRPC trio OTLP/Jaeger/OpenCensus
-when grpcio is present), then drives concurrent writer/reader virtual
-users for --duration seconds and emits ONE pass/fail JSON line.
+sweeps one trace through EVERY ingest protocol, then drives a MIXED
+workload — ingest + trace-by-ID find + live-tail search + historical
+search + TraceQL metrics query_range — at `--rate` times the seed rate
+for --duration seconds, and emits ONE JSON line whose `slo` section is
+a machine-checkable gate:
+
+- per-op latency percentiles (p50/p90/p99) vs thresholds,
+- per-op error rate vs threshold (sheds are NOT errors),
+- every shed response must carry a retry hint (429 + Retry-After) —
+  `shed_without_hint` must be 0,
+- zero acknowledged-span loss: a sample of acked writes must be
+  queryable after the drain,
+- bounded RSS: per-process RSS is sampled through the run and the
+  final-quarter mean must not exceed `--rss-growth-limit` times the
+  second-quarter mean (monotonic growth under sustained load = leak).
+
+Exit code is nonzero on any gate breach, so CI can use the rig as-is.
 
 Usage:
-  python tools/loadtest.py --duration 120 --writers 4 --readers 2
+  python tools/loadtest.py --duration 120 --rate 10
   python tools/loadtest.py --url http://host:3200 ...   # existing cluster
 """
 
@@ -31,8 +45,6 @@ import urllib.error
 import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-from tools.smoke import HTTPTarget, Thresholds, run_smoke  # noqa: E402
 
 
 def _free_port() -> int:
@@ -356,14 +368,325 @@ def query_range_probe(query_url: str, n: int = 10) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# mixed-workload rig: ingest + find + live tail + historical search +
+# query_range at --rate x the seed rate, with SLO gates
+# ---------------------------------------------------------------------------
+
+# seed-rate targets (ops/s at --rate 1); --rate multiplies the lot.
+SEED_RATES = {"write": 20.0, "find": 10.0, "search_live": 2.0,
+              "search_hist": 1.0, "query_range": 1.0}
+
+# per-op SLO thresholds: (p99 latency s, max error rate). Sheds are not
+# errors — they are the control plane working — but every shed MUST
+# carry a retry hint, gated separately via shed_without_hint == 0.
+DEFAULT_SLO = {
+    "write": (1.5, 0.01),
+    "find": (1.5, 0.10),  # includes not-yet-flushed races under load
+    "search_live": (3.0, 0.05),
+    "search_hist": (3.0, 0.05),
+    "query_range": (5.0, 0.05),
+}
+
+
+class OpStats:
+    def __init__(self):
+        import threading
+
+        self.lock = threading.Lock()
+        self.lat: dict[str, list] = {}
+        self.counts: dict[str, dict] = {}
+
+    def record(self, op: str, outcome: str, dt: float, hint_ok: bool = True):
+        """outcome: ok | shed | error. hint_ok=False marks a shed that
+        arrived WITHOUT a Retry-After hint (a gate breach)."""
+        with self.lock:
+            self.lat.setdefault(op, []).append(dt)
+            c = self.counts.setdefault(
+                op, {"ok": 0, "shed": 0, "error": 0, "shed_without_hint": 0})
+            c[outcome] += 1
+            if outcome == "shed" and not hint_ok:
+                c["shed_without_hint"] += 1
+
+    def summary(self, slo: dict) -> tuple[dict, bool]:
+        with self.lock:
+            lat = {op: sorted(v) for op, v in self.lat.items()}
+            counts = {op: dict(c) for op, c in self.counts.items()}
+        out, passed = {}, True
+        for op, c in counts.items():
+            ls = lat.get(op, [])
+            pct = lambda p: round(ls[min(len(ls) - 1, int(len(ls) * p))], 4) if ls else 0.0
+            total = c["ok"] + c["shed"] + c["error"]
+            err_rate = c["error"] / total if total else 0.0
+            p99_limit, err_limit = slo.get(op, (float("inf"), 1.0))
+            gates = {
+                "p99": pct(0.99) <= p99_limit,
+                "error_rate": err_rate <= err_limit,
+                "shed_hints": c["shed_without_hint"] == 0,
+            }
+            passed = passed and all(gates.values())
+            out[op] = {
+                "total": total, **c,
+                "error_rate": round(err_rate, 4),
+                "p50_s": pct(0.50), "p90_s": pct(0.90), "p99_s": pct(0.99),
+                "gates": gates,
+            }
+        return out, passed
+
+
+def _request(url: str, method: str = "GET", body: bytes | None = None,
+             ct: str = "", timeout: float = 60.0):
+    """-> (status, headers dict) — 4xx/5xx come back as a status, not an
+    exception, so the callers can classify sheds."""
+    req = urllib.request.Request(
+        url, data=body, method=method,
+        headers={"Content-Type": ct} if ct else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            r.read()
+            return r.status, dict(r.headers)
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code, dict(e.headers)
+
+
+def run_mixed_load(write_url: str, query_url: str, duration_s: float,
+                   rate: float, spans_per_trace: int = 5,
+                   slo: dict | None = None, read_lag_s: float = 2.0,
+                   seed: int = 1):
+    """Drive the mixed workload; returns (summary dict, acked trace-id
+    list) — acked = writes the cluster ACCEPTED (HTTP 200), the set the
+    zero-loss gate verifies after the drain."""
+    import random
+    import threading
+    import urllib.parse
+
+    from tempo_tpu.receivers import otlp
+    from tempo_tpu.model import synth
+
+    slo = slo or DEFAULT_SLO
+    stats = OpStats()
+    acked: list = []  # (monotonic, trace_id)
+    acked_lock = threading.Lock()
+    stop = threading.Event()
+
+    def classify(status: int, headers: dict) -> tuple[str, bool]:
+        if 200 <= status < 300:
+            return "ok", True
+        if status == 429:
+            return "shed", "Retry-After" in headers
+        return "error", True
+
+    def paced_loop(op: str, fn, n_threads: int, ops_s: float):
+        interval = n_threads / max(ops_s, 0.001)
+
+        def run(tid: int):
+            import zlib
+
+            rng = random.Random(seed * 7919 + zlib.crc32(op.encode()) + tid)
+            nxt = time.monotonic() + rng.uniform(0, interval)
+            while not stop.is_set():
+                delay = nxt - time.monotonic()
+                if delay > 0 and stop.wait(min(delay, 0.5)):
+                    return
+                if time.monotonic() < nxt:
+                    continue
+                nxt += interval
+                t0 = time.monotonic()
+                try:
+                    outcome, hint_ok = fn(rng)
+                except Exception:
+                    outcome, hint_ok = "error", True
+                stats.record(op, outcome, time.monotonic() - t0, hint_ok)
+
+        return [threading.Thread(target=run, args=(i,), daemon=True, name=f"{op}-{i}")
+                for i in range(n_threads)]
+
+    seq = [0]
+    seq_lock = threading.Lock()
+
+    def do_write(rng):
+        with seq_lock:
+            seq[0] += 1
+            i = seq[0]
+        traces = synth.make_traces(2, seed=seed * 1_000_000 + i,
+                                   spans_per_trace=spans_per_trace)
+        status, headers = _request(
+            write_url + "/v1/traces", "POST",
+            otlp.encode_traces_request(traces), "application/x-protobuf")
+        outcome, hint_ok = classify(status, headers)
+        if outcome == "ok":
+            with acked_lock:
+                for t in traces:
+                    acked.append((time.monotonic(), t.trace_id))
+        return outcome, hint_ok
+
+    def pick_acked(rng):
+        with acked_lock:
+            eligible = len(acked)
+            while eligible and time.monotonic() - acked[eligible - 1][0] < read_lag_s:
+                eligible -= 1
+            if not eligible:
+                return None
+            return acked[rng.randrange(eligible)][1]
+
+    def do_find(rng):
+        tid = pick_acked(rng)
+        if tid is None:
+            return "ok", True  # nothing acked yet; not a failure
+        status, headers = _request(f"{query_url}/api/traces/{tid.hex()}")
+        return classify(status, headers)
+
+    def do_search_live(rng):
+        now = int(time.time())
+        svc = rng.choice(synth.SERVICES)
+        qs = urllib.parse.urlencode({
+            "tags": f"service.name={svc}", "start": now - 300, "end": now + 5,
+            "limit": 10,
+        })
+        status, headers = _request(f"{query_url}/api/search?{qs}")
+        return classify(status, headers)
+
+    def do_search_hist(rng):
+        now = int(time.time())
+        svc = rng.choice(synth.SERVICES)
+        qs = urllib.parse.urlencode({
+            "tags": f"service.name={svc}",
+            "start": now - 7200, "end": now - 3600, "limit": 10,
+        })
+        status, headers = _request(f"{query_url}/api/search?{qs}")
+        return classify(status, headers)
+
+    def do_query_range(rng):
+        end = int(time.time())
+        qs = urllib.parse.urlencode({
+            "q": "{} | rate() by (resource.service.name)",
+            "start": end - 300, "end": end, "step": 2,
+        })
+        status, headers = _request(f"{query_url}/api/metrics/query_range?{qs}")
+        return classify(status, headers)
+
+    fns = {"write": do_write, "find": do_find, "search_live": do_search_live,
+           "search_hist": do_search_hist, "query_range": do_query_range}
+    threads = []
+    for op, fn in fns.items():
+        ops_s = SEED_RATES[op] * rate
+        n_threads = max(1, min(32, int(ops_s / 5) + 1))
+        threads += paced_loop(op, fn, n_threads, ops_s)
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    ops, slo_pass = stats.summary(slo)
+    with acked_lock:
+        acked_ids = [tid for _, tid in acked]
+    return {"ops": ops, "slo_pass": slo_pass, "acked_writes": len(acked_ids)}, acked_ids
+
+
+def verify_acked(query_url: str, acked_ids: list, sample: int = 25,
+                 timeout_s: float = 45.0, seed: int = 1) -> dict:
+    """Zero-acknowledged-loss gate: a random sample of ACCEPTED writes
+    must become queryable once ingest drains. Anything the cluster shed
+    (429) was never acked and is exempt by construction."""
+    import random
+
+    rng = random.Random(seed)
+    ids = list(dict.fromkeys(acked_ids))
+    if len(ids) > sample:
+        ids = rng.sample(ids, sample)
+    pending = {tid for tid in ids}
+    deadline = time.time() + timeout_s
+    while pending and time.time() < deadline:
+        for tid in list(pending):
+            try:
+                status, _ = _request(f"{query_url}/api/traces/{tid.hex()}", timeout=10)
+            except Exception:
+                # connection-level blip while the cluster drains the
+                # backlog: keep polling until the deadline
+                continue
+            if status == 200:
+                pending.discard(tid)
+        if pending:
+            time.sleep(0.5)
+    return {
+        "sampled": len(ids),
+        "lost": len(pending),
+        "lost_ids": sorted(t.hex() for t in pending)[:10],
+        "passed": not pending,
+    }
+
+
+class RSSSampler:
+    """Samples each cluster process's RSS once a second; the gate rejects
+    monotonic growth (final-quarter mean vs second-quarter mean)."""
+
+    def __init__(self, procs: list):
+        import threading
+
+        self.procs = [(p.name, p.proc.pid) for p in procs]
+        self.series: dict[str, list] = {name: [] for name, _ in self.procs}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    @staticmethod
+    def _rss(pid: int) -> int:
+        from tempo_tpu.util.resource import sample_rss_bytes
+
+        return sample_rss_bytes(pid)
+
+    def _run(self):
+        while not self._stop.wait(1.0):
+            for name, pid in self.procs:
+                v = self._rss(pid)
+                if v:
+                    self.series[name].append(v)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop_and_summary(self, growth_limit: float = 1.5) -> dict:
+        self._stop.set()
+        self._thread.join(timeout=2)
+        out, passed = {}, True
+        for name, vals in self.series.items():
+            if len(vals) < 8:
+                out[name] = {"samples": len(vals), "gate": None}
+                continue
+            q = len(vals) // 4
+            early = sum(vals[q:2 * q]) / q
+            late = sum(vals[-q:]) / q
+            ratio = late / early if early else 1.0
+            ok = ratio <= growth_limit
+            passed = passed and ok
+            out[name] = {
+                "samples": len(vals),
+                "rss_mb_early": round(early / 2**20, 1),
+                "rss_mb_late": round(late / 2**20, 1),
+                "growth_ratio": round(ratio, 3),
+                "gate": ok,
+            }
+        return {"procs": out, "passed": passed}
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--url", help="existing cluster URL (skips spawning)")
     ap.add_argument("--duration", type=float, default=120.0)
-    ap.add_argument("--writers", type=int, default=4)
-    ap.add_argument("--readers", type=int, default=2)
+    ap.add_argument("--rate", type=float, default=1.0,
+                    help="workload multiplier over the seed rates "
+                         "(10-100 = the ROADMAP overload regime)")
     ap.add_argument("--spans-per-trace", type=int, default=5)
     ap.add_argument("--skip-sweep", action="store_true")
+    ap.add_argument("--rss-growth-limit", type=float, default=1.5,
+                    help="max final/early mean-RSS ratio per process")
+    ap.add_argument("--slo-scale", type=float, default=1.0,
+                    help="multiply the p99 latency budgets (CI containers "
+                         "share cores with the cluster under test; the "
+                         "error/shed/loss/RSS gates are never scaled)")
     ap.add_argument("--query-range", action="store_true",
                     help="probe /api/metrics/query_range after the load "
                          "and gate on matrix responses")
@@ -392,34 +715,38 @@ def main() -> int:
         if not args.skip_sweep:
             sweep = receiver_sweep(write_url, query_url, grpc_port=grpc_port if procs else 0)
             print(f"[loadtest] receiver sweep: {sweep}", file=sys.stderr)
-
-        target = HTTPTarget(write_url)
-        # reads go to the frontend (sharded path), writes to the distributor
-        read_target = HTTPTarget(query_url)
-
-        class SplitTarget:
-            def write(self, traces):
-                return target.write(traces)
-
-            def read(self, trace_id):
-                return read_target.read(trace_id)
-
-        summary = run_smoke(
-            SplitTarget(),
-            duration_s=args.duration,
-            writers=args.writers,
-            readers=args.readers,
-            spans_per_trace=args.spans_per_trace,
-            thresholds=Thresholds(),
-        )
-        summary["receiver_sweep"] = sweep
         sweep_ok = all(v in ("ok", "skipped") for v in sweep.values()) if sweep else True
+
+        rss = RSSSampler(procs).start() if procs else None
+        slo = {op: (p99 * args.slo_scale, err) for op, (p99, err) in DEFAULT_SLO.items()}
+        summary, acked_ids = run_mixed_load(
+            write_url, query_url, duration_s=args.duration, rate=args.rate,
+            spans_per_trace=args.spans_per_trace, slo=slo,
+        )
+        print(f"[loadtest] mixed load done: {summary['acked_writes']} acked writes, "
+              f"slo_pass={summary['slo_pass']}", file=sys.stderr)
+
+        loss = verify_acked(query_url, acked_ids)
+        summary["acked_loss"] = loss
+        print(f"[loadtest] acked-loss check: {loss}", file=sys.stderr)
+
+        if rss is not None:
+            summary["rss"] = rss.stop_and_summary(args.rss_growth_limit)
+            print(f"[loadtest] rss: {summary['rss']}", file=sys.stderr)
+
+        summary["receiver_sweep"] = sweep
+        summary["rate"] = args.rate
         if args.query_range:
             qr = query_range_probe(query_url)
             print(f"[loadtest] query_range probe: {qr}", file=sys.stderr)
             summary["query_range"] = qr
             sweep_ok = sweep_ok and qr["passed"]
-        summary["passed"] = bool(summary["passed"] and sweep_ok)
+        summary["passed"] = bool(
+            summary["slo_pass"]
+            and loss["passed"]
+            and sweep_ok
+            and (rss is None or summary["rss"]["passed"])
+        )
         print(json.dumps(summary))
         return 0 if summary["passed"] else 1
     finally:
