@@ -264,13 +264,30 @@ def _search_rep(reps: int = 3) -> dict:
             "legacy": ({}, legacy_metas, legacy_backend),
         }
 
-        def run_once(req, ms, be) -> SearchResponse:
+        def run_once(req, ms, be, waterfall: dict | None = None) -> SearchResponse:
+            from tempo_tpu.util import stagetimings
+
             cache = shared_cache()
             if cache is not None:
                 cache.clear()  # every run pays its own IO
             out = SearchResponse()
-            for m in ms:
-                out.merge(enc.open_block(m, be, cfg).search(req))
+            # the rep records WHERE the time goes, not just totals: the
+            # stage waterfall (fetch/decode/zonemap/kernel + dispatch
+            # counts) rides the JSON artifact so BENCH_r09+ can show the
+            # host-vs-device split per arm
+            with stagetimings.request() as st:
+                for m in ms:
+                    out.merge(enc.open_block(m, be, cfg).search(req))
+            if waterfall is not None:
+                wire = st.to_wire()
+                stage_s = wire["stageSeconds"]
+                host_s = sum(v for k, v in stage_s.items() if k != "kernel")
+                waterfall.update({
+                    "stage_seconds": stage_s,
+                    "host_s": round(host_s, 6),
+                    "device_s": round(stage_s.get("kernel", 0.0), 6),
+                    "device_dispatches": wire["deviceDispatches"],
+                })
             return out
 
         per_query: dict[str, dict] = {}
@@ -282,12 +299,13 @@ def _search_rep(reps: int = 3) -> dict:
             for arm, (env, ms, be) in ARMS.items():
                 for k, v in env.items():
                     os.environ[k] = v
+                wf: dict = {}
                 try:
                     run_once(req, ms, be)  # warm the page cache, not the column cache
                     times = []
                     for _ in range(reps):
                         t0 = time.perf_counter()
-                        resp = run_once(req, ms, be)
+                        resp = run_once(req, ms, be, waterfall=wf)
                         times.append(time.perf_counter() - t0)
                 finally:
                     for k in env:
@@ -298,6 +316,7 @@ def _search_rep(reps: int = 3) -> dict:
                     "decoded": resp.decoded_bytes,
                     "pruned_row_groups": resp.pruned_row_groups,
                     "coalesced_reads": resp.coalesced_reads,
+                    "waterfall": wf,  # last rep's stage split
                 }
                 hitsets[arm] = {t.trace_id_hex for t in resp.traces}
                 totals[arm]["s"] += arms[arm]["s"]
@@ -324,6 +343,8 @@ def _search_rep(reps: int = 3) -> dict:
                 "coalesced_reads": arms["pruned"]["coalesced_reads"],
                 "hits": len(hitsets["pruned"]),
                 "parity": parity,
+                # where the pruned arm's time goes (stage waterfall)
+                "waterfall": arms["pruned"]["waterfall"],
             }
         return {
             **per_query,
@@ -749,6 +770,19 @@ def main():
         print("bench.py: refusing to run with TEMPO_TPU_FAULTS armed "
               f"({os.environ['TEMPO_TPU_FAULTS']!r}) — unset it; perf reps "
               "must measure the fault-free path", file=sys.stderr)
+        sys.exit(2)
+
+    # self-tracing-off guard (same contract as faults): the dogfood
+    # exporter pushes the engine's own spans through the ingest path,
+    # which would pollute every rep with observer traffic. The stage
+    # waterfall the search rep records (stagetimings) is passive and
+    # allocation-free; the EXPORTER is the part that generates load.
+    from tempo_tpu.util import tracing as _tracing
+
+    if _tracing.TRACER.exporter is not None:
+        print("bench.py: refusing to run with a self-tracing exporter "
+              "installed — dogfood traffic would pollute the measurements",
+              file=sys.stderr)
         sys.exit(2)
 
     # partial state every failure artifact (crash OR watchdog) reports.

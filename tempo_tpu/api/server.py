@@ -41,7 +41,7 @@ from tempo_tpu.modules.distributor import RateLimited
 from tempo_tpu.modules.ingester import MaxLiveTraces, TraceTooLarge
 from tempo_tpu.modules.queue import TooManyRequests
 from tempo_tpu.receivers import otlp
-from tempo_tpu.util import metrics
+from tempo_tpu.util import metrics, tracing
 from tempo_tpu.util.resource import ResourceExhausted
 
 VERSION = "0.1.0"
@@ -174,13 +174,33 @@ class _Handler(BaseHTTPRequestHandler):
             return "/rpc/v1/ingester/trace/{traceID}"
         return p
 
+    # paths that poll/long-poll constantly: a root span per request
+    # would flood the dogfood tenant with noise traces (the reference
+    # similarly leaves health/metrics endpoints uninstrumented)
+    _UNTRACED = ("/metrics", "/ready", "/rpc/v1/worker/pull")
+
+    def _traced_handle(self, method: str, url, route: str) -> int:
+        """Extract the inbound W3C traceparent (reference: the server's
+        otelhttp middleware) and open one server span per request, so an
+        instrumented client's push/query and our internal RPC hops land
+        in one coherent trace."""
+        if (not tracing.TRACER.enabled or route in self._UNTRACED
+                or url.path.startswith("/kv/")):
+            return self._handle(method, url)
+        with tracing.remote_context(self.headers.get(tracing.TRACEPARENT_HEADER)):
+            with tracing.span(f"http/{method} {route}", route=route) as s:
+                code = self._handle(method, url)
+                if s is not None:
+                    s.attributes["status_code"] = code
+                return code
+
     def _route(self, method: str) -> None:
         start = time.monotonic()
         url = urlparse(self.path)
         route = self._route_template(url.path)
         code = 500
         try:
-            code = self._handle(method, url)
+            code = self._traced_handle(method, url, route)
         except BadRequest as e:
             code = 400
             self._send_error(400, str(e))
@@ -449,7 +469,9 @@ class _Handler(BaseHTTPRequestHandler):
             return 200
         if path == "/status/profile":
             # sampling CPU profile of all threads (reference analog:
-            # net/http/pprof, cmd/tempo/main.go:57,90)
+            # net/http/pprof, cmd/tempo/main.go:57,90). ?fmt=collapsed
+            # emits semicolon-folded stacks + counts — pipe straight
+            # into flamegraph.pl / speedscope (pprof's -raw analog)
             from tempo_tpu.util.profiling import sample_profile
 
             try:
@@ -457,7 +479,24 @@ class _Handler(BaseHTTPRequestHandler):
                 hz = int(qs.get("hz", ["100"])[0])
             except ValueError as e:
                 raise BadRequest(f"bad profile params: {e}") from e
-            self._send(200, sample_profile(seconds, hz).encode(), "text/plain; charset=utf-8")
+            fmt_ = qs.get("fmt", ["text"])[0]
+            if fmt_ not in ("text", "collapsed"):
+                raise BadRequest(f"unknown profile fmt {fmt_!r} (have text|collapsed)")
+            self._send(200, sample_profile(seconds, hz, fmt=fmt_).encode(),
+                       "text/plain; charset=utf-8")
+            return 200
+        if path == "/status/profile/device":
+            # bounded device profiler capture (reference analog: pprof's
+            # CPU profile window, but for the accelerator): runs
+            # jax.profiler for ?seconds and reports the trace directory;
+            # degrades to {"supported": false} when the backend can't
+            from tempo_tpu.util.profiling import capture_device_profile
+
+            try:
+                seconds = float(qs.get("seconds", ["1"])[0])
+            except ValueError as e:
+                raise BadRequest(f"bad profile params: {e}") from e
+            self._send_json(200, capture_device_profile(seconds))
             return 200
 
         self._send_error(404, "not found")
@@ -544,9 +583,14 @@ class _Handler(BaseHTTPRequestHandler):
                     "decodedBytes": str(stats.get("decodedBytes", 0)),
                     "inspectedBlocks": stats.get("inspectedBlocks", 0),
                     "elapsedMs": int((time.monotonic() - t0) * 1000),
+                    # the execution waterfall (util/stagetimings): where
+                    # this query's milliseconds and dispatches went
+                    "stageSeconds": stats.get("stageSeconds", {}),
+                    "deviceDispatches": stats.get("deviceDispatches", 0),
                 },
             }
         else:
+            t0 = time.monotonic()
             resp = self.app.search(req, org_id=org)
             doc = {
                 "traces": [t.to_dict() for t in resp.traces],
@@ -555,6 +599,9 @@ class _Handler(BaseHTTPRequestHandler):
                     "inspectedBytes": str(resp.inspected_bytes),
                     "decodedBytes": str(resp.decoded_bytes),
                     "inspectedBlocks": resp.inspected_blocks,
+                    "elapsedMs": int((time.monotonic() - t0) * 1000),
+                    "stageSeconds": resp.stage_seconds,
+                    "deviceDispatches": resp.device_dispatches,
                 },
             }
         self._send_json(200, doc)
@@ -580,6 +627,7 @@ _ENDPOINTS = [
     "GET /status/services",
     "GET /status/endpoints",
     "GET /status/profile",
+    "GET /status/profile/device",
     "GET /status/usage-stats",
     "GET /status/runtime_config",
     "POST /flush",

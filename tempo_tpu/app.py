@@ -33,7 +33,10 @@ from tempo_tpu.modules.rpc import (
     RPCHandler,
 )
 from tempo_tpu.modules.worker import JobBroker, LocalWorkerPool, RemoteWorker
-from tempo_tpu.util import resource
+from tempo_tpu.util import devicetiming  # noqa: F401 — registers the
+# device-dispatch histograms so /metrics exposes them from boot, not
+# from the first dispatch
+from tempo_tpu.util import resource, tracing
 
 log = logging.getLogger(__name__)
 
@@ -88,6 +91,13 @@ class AppConfig:
     resource: "resource.ResourceConfig" = field(
         default_factory=resource.ResourceConfig
     )
+    # self-observability dogfood loop (util/tracing.SelfTracingConfig):
+    # when enabled, the process exports its own spans into its own
+    # ingest path under the reserved `_self_` tenant — sampled and
+    # rate-bounded, dropped entirely under memory pressure
+    self_tracing: "tracing.SelfTracingConfig" = field(
+        default_factory=tracing.SelfTracingConfig
+    )
 
 
 class RoleUnavailable(RuntimeError):
@@ -137,10 +147,13 @@ class App:
         self.kv_service = KVService()
         self._net_kvs: list = []
 
+        self._self_exporter = None
+        self._self_export_client = None
         if target == "all":
             self._build_all()
         else:
             self._build_role(target)
+        self._maybe_self_tracing()
 
     # ------------------------------------------------------------------
     def _hb_period(self) -> float:
@@ -320,6 +333,53 @@ class App:
 
         raise AssertionError(role)
 
+    def _maybe_self_tracing(self):
+        """Close the dogfood loop: the global tracer exports finished
+        traces into the system's ingest path under the `_self_` tenant,
+        so TraceQL / query_range over `_self_` answers "what is the
+        engine doing to itself" (reference: the deployment points its
+        own Jaeger client at its own ingest). A process with a
+        distributor pushes locally; any other role ships OTLP/HTTP to
+        `self_tracing.endpoint` (a distributor-serving process), so
+        cross-process traces carry every role's spans, not just the
+        distributor's."""
+        cfg = self.cfg.self_tracing
+        if not cfg.enabled:
+            return
+        if self.distributor is not None:
+            dist = self.distributor
+
+            def push(tenant: str, traces) -> None:
+                dist.push_traces(tenant, traces)
+        elif cfg.endpoint:
+            from tempo_tpu.backend.httpclient import PooledHTTPClient
+            from tempo_tpu.receivers import otlp
+
+            # no retries, short timeout: the exporter's contract is
+            # drop-never-amplify, and its re-entrancy guard keeps this
+            # POST itself from spawning spans
+            client = PooledHTTPClient(cfg.endpoint, timeout_s=5.0, max_retries=0)
+            self._self_export_client = client
+
+            def push(tenant: str, traces) -> None:
+                client.request(
+                    "POST", "/v1/traces",
+                    headers={"Content-Type": "application/x-protobuf",
+                             "X-Scope-OrgID": tenant},
+                    body=otlp.encode_traces_request(traces),
+                    ok=(200,),
+                )
+        else:
+            log.warning(
+                "self_tracing enabled but target=%s has no distributor and "
+                "no self_tracing.endpoint: this role will record nothing",
+                self.target,
+            )
+            return
+        self._self_exporter = tracing.SelfTraceExporter(
+            push, cfg, governor=self.governor)
+        tracing.install_exporter(self._self_exporter, cfg.service_name)
+
     def _maybe_usage_reporter(self):
         cfg = self.cfg
         if cfg.usage_stats is not None and getattr(cfg.usage_stats, "enabled", False):
@@ -329,7 +389,14 @@ class App:
 
     # -- tenant resolution ----------------------------------------------
     def resolve_tenant(self, org_id: str | None) -> str:
-        """Reference: multitenancy via X-Scope-OrgID (app auth middleware)."""
+        """Reference: multitenancy via X-Scope-OrgID (app auth middleware).
+
+        The reserved dogfood tenant (`_self_`) is addressable even
+        without multitenancy — self-traces land there regardless, and an
+        operator must be able to query them from a single-tenant
+        deployment (X-Scope-OrgID: _self_)."""
+        if org_id == tracing.SELF_TENANT:
+            return tracing.SELF_TENANT
         if not self.cfg.multitenancy_enabled:
             return DEFAULT_TENANT
         if not org_id:
@@ -408,6 +475,16 @@ class App:
         return states
 
     def shutdown(self):
+        # detach the dogfood exporter FIRST: a background sweep/flush
+        # must not export into a distributor that is tearing down (and
+        # tests build many apps per process — only OUR exporter is
+        # removed, never a newer app's)
+        if self._self_exporter is not None:
+            tracing.uninstall_exporter(self._self_exporter)
+            self._self_exporter = None
+        if self._self_export_client is not None:
+            self._self_export_client.close()
+            self._self_export_client = None
         for stop in self._heartbeat_stops:
             stop.set()
         for ring, iid in self._registered:

@@ -23,7 +23,7 @@ import time
 import urllib.parse
 from dataclasses import dataclass
 
-from tempo_tpu.util import deadline, metrics
+from tempo_tpu.util import deadline, metrics, tracing
 
 hedged_total = metrics.counter(
     "tempo_backend_hedged_roundtrips_total",
@@ -151,6 +151,13 @@ class PooledHTTPClient:
         """
         headers = dict(headers or {})
         headers.setdefault("Host", self.host if self.port is None else f"{self.host}:{self.port}")
+        # propagate the active trace context (W3C traceparent) on every
+        # internal request, so distributor→ingester and frontend→worker
+        # hops join the caller's trace (reference: otelhttp transport
+        # wrapping every internal client); absent when no span is open
+        tp = tracing.current_traceparent()
+        if tp is not None:
+            headers.setdefault(tracing.TRACEPARENT_HEADER, tp)
         if body is not None:
             headers.setdefault("Content-Length", str(len(body)))
         idempotent = method in ("GET", "HEAD", "PUT", "DELETE")

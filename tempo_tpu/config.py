@@ -31,6 +31,7 @@ from tempo_tpu.modules.ingester import IngesterConfig
 from tempo_tpu.modules.overrides import Limits
 from tempo_tpu.usagestats import UsageStatsConfig
 from tempo_tpu.util.resource import ResourceConfig
+from tempo_tpu.util.tracing import SelfTracingConfig
 
 log = logging.getLogger(__name__)
 
@@ -183,6 +184,9 @@ def parse_config(text: str, env: dict | None = None) -> Config:
     app.usage_stats = _from_dict(UsageStatsConfig, doc.pop("usage_report", None), "usage_report")
     # overload control plane budgets (util/resource.ResourceGovernor)
     app.resource = _from_dict(ResourceConfig, doc.pop("resource", None), "resource")
+    # self-observability: the engine traces itself into `_self_`
+    app.self_tracing = _from_dict(
+        SelfTracingConfig, doc.pop("self_tracing", None), "self_tracing")
 
     for key in ("replication_factor", "n_ingesters", "query_workers"):
         if key in doc:
@@ -249,6 +253,17 @@ def check_config(cfg: Config) -> list[str]:
         warnings.append(
             "ingester.max_block_bytes exceeds resource.wal_head_bytes: a single head "
             "block can push the process to critical pressure before it is cut"
+        )
+    if app.self_tracing.enabled and app.self_tracing.max_spans_per_s > 50_000:
+        warnings.append(
+            f"self_tracing.max_spans_per_s ({app.self_tracing.max_spans_per_s:g}) "
+            "is a large share of typical ingest: the observer should stay a "
+            "rounding error next to user traffic"
+        )
+    if app.self_tracing.enabled and not (0.0 <= app.self_tracing.sample_ratio <= 1.0):
+        warnings.append(
+            f"self_tracing.sample_ratio ({app.self_tracing.sample_ratio}) is "
+            "outside [0, 1]; values clamp to never/always"
         )
     resident_cap = app.frontend.target_bytes_per_job * max(1, app.frontend.query_shards)
     if 0 < app.resource.inflight_query_bytes < 2 * resident_cap:

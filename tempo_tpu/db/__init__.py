@@ -283,7 +283,7 @@ class TempoDB:
         includeBlock shard-range + time filtering :494-517; self-traced
         like the reference's tempodb.go:276 span). Partial traces from
         multiple blocks are combined."""
-        with tracing.span("tempodb.Find", tenant=tenant):
+        with tracing.span("tempodb/find", tenant=tenant):
             return self._find_traced(tenant, trace_id, block_start, block_end,
                                      time_start, time_end)
 
@@ -298,8 +298,10 @@ class TempoDB:
         ]
 
         def job(meta):
-            blk = self.encoding_for(meta.version).open_block(meta, self.backend, self.cfg.block)
-            return blk.find_trace_by_id(trace_id)
+            with tracing.span("tempodb/find_block", block=str(meta.block_id)):
+                blk = self.encoding_for(meta.version).open_block(
+                    meta, self.backend, self.cfg.block)
+                return blk.find_trace_by_id(trace_id)
 
         results, errors = self.pool.run_jobs(
             [lambda m=m: self.guard_block(tenant, m.block_id, lambda: job(m)) for m in metas]
@@ -341,8 +343,16 @@ class TempoDB:
         out = SearchResponse()
 
         def job(meta):
-            blk = self.encoding_for(meta.version).open_block(meta, self.backend, self.cfg.block)
-            return blk.search(req)
+            # per-block span (pool threads inherit the worker span via
+            # the copied context, so these land as its children)
+            with tracing.span("tempodb/search_block", block=str(meta.block_id)) as s:
+                blk = self.encoding_for(meta.version).open_block(
+                    meta, self.backend, self.cfg.block)
+                r = blk.search(req)
+                if s is not None:
+                    s.attributes["inspected_bytes"] = r.inspected_bytes
+                    s.attributes["pruned_row_groups"] = r.pruned_row_groups
+                return r
 
         seen_ids: set = set()
 
@@ -421,9 +431,12 @@ class TempoDB:
         bounded to a row-group subrange (the serverless/page-shard unit)."""
 
         def run():
-            meta = self.backend.block_meta(tenant, block_id)
-            blk = self.encoding_for(meta.version).open_block(meta, self.backend, self.cfg.block)
-            return blk.search(req, start_row_group=start_row_group, row_groups=row_groups)
+            with tracing.span("tempodb/search_block", block=str(block_id)):
+                meta = self.backend.block_meta(tenant, block_id)
+                blk = self.encoding_for(meta.version).open_block(
+                    meta, self.backend, self.cfg.block)
+                return blk.search(req, start_row_group=start_row_group,
+                                  row_groups=row_groups)
 
         return self.guard_block(tenant, block_id, run)
 
@@ -435,14 +448,16 @@ class TempoDB:
         metas = [m for m in self.blocklist.metas(tenant) if _overlaps(m, start_s, end_s)]
 
         def job(meta):
-            blk = self.encoding_for(meta.version).open_block(meta, self.backend, self.cfg.block)
-            out = blk.fetch_candidates(spec, start_s, end_s)
-            # counters returned with the result: jobs run on pool threads
-            # and a shared dict bump would race
-            return (out, getattr(blk, "bytes_read", 0),
-                    getattr(blk, "pruned_row_groups", 0),
-                    getattr(blk, "coalesced_reads", 0),
-                    getattr(blk, "decoded_bytes", 0))
+            with tracing.span("tempodb/fetch_block", block=str(meta.block_id)):
+                blk = self.encoding_for(meta.version).open_block(
+                    meta, self.backend, self.cfg.block)
+                out = blk.fetch_candidates(spec, start_s, end_s)
+                # counters returned with the result: jobs run on pool
+                # threads and a shared dict bump would race
+                return (out, getattr(blk, "bytes_read", 0),
+                        getattr(blk, "pruned_row_groups", 0),
+                        getattr(blk, "coalesced_reads", 0),
+                        getattr(blk, "decoded_bytes", 0))
 
         results, errors = self.pool.run_jobs(
             [lambda m=m: self.guard_block(tenant, m.block_id, lambda: job(m)) for m in metas]

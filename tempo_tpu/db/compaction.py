@@ -16,7 +16,7 @@ import time
 from dataclasses import dataclass, field
 
 from tempo_tpu.backend.base import BlockMeta, CompactedBlockMeta
-from tempo_tpu.util import metrics
+from tempo_tpu.util import metrics, tracing
 
 log = logging.getLogger(__name__)
 
@@ -203,6 +203,17 @@ class CompactionDriver:
                           tenant, m.block_id, probe_err)
 
     def compact_blocks(self, tenant: str, group: list[BlockMeta]):
+        # one trace per compaction job; the engine's plan/relocate/
+        # merge/put spans (encoding/vtpu/compactor.py) land as children,
+        # so `{ .service = "tempo-tpu" && name = "compactor/merge" }
+        # | quantile_over_time(duration, .99)` over `_self_` is the
+        # compaction profiler (reference: tempodb compaction spans)
+        with tracing.span("compactor/job", tenant=tenant,
+                          inputs=len(group),
+                          bytes=sum(m.size_bytes for m in group)):
+            return self._compact_blocks_traced(tenant, group)
+
+    def _compact_blocks_traced(self, tenant: str, group: list[BlockMeta]):
         enc = self.db.encoding_for(group[0].version)
         compactor = enc.new_compactor(self.db.compaction_options())
         warn = None

@@ -156,6 +156,10 @@ class SearchResponse:
     # partial-result accounting)
     status: str = "complete"
     failed_shards: int = 0
+    # execution waterfall (util/stagetimings): stage -> seconds, merged
+    # shard-wise by the frontend; empty until the frontend attaches it
+    stage_seconds: dict = field(default_factory=dict)
+    device_dispatches: int = 0
 
     def merge(self, other: "SearchResponse", limit: int = 0) -> None:
         seen = {t.trace_id_hex for t in self.traces}
@@ -175,6 +179,9 @@ class SearchResponse:
         if other.status == "partial":
             self.status = "partial"
         self.failed_shards += other.failed_shards
+        for k, v in other.stage_seconds.items():
+            self.stage_seconds[k] = self.stage_seconds.get(k, 0.0) + v
+        self.device_dispatches += other.device_dispatches
 
     def to_dict(self) -> dict:
         d = {
@@ -193,6 +200,13 @@ class SearchResponse:
             # byte-identical to the pre-partial wire form
             d["status"] = self.status
             d["metrics"]["failedShards"] = self.failed_shards
+        if self.stage_seconds:
+            # only the frontend's final merge carries a waterfall; block
+            # and worker partials stay byte-identical to the old wire
+            d["metrics"]["stageSeconds"] = {
+                k: round(v, 6) for k, v in self.stage_seconds.items()
+            }
+            d["metrics"]["deviceDispatches"] = self.device_dispatches
         return d
 
     @staticmethod
@@ -217,4 +231,8 @@ class SearchResponse:
         resp.coalesced_reads = m.get("coalescedReads", 0)
         resp.status = doc.get("status", "complete")
         resp.failed_shards = m.get("failedShards", 0)
+        resp.stage_seconds = {
+            str(k): float(v) for k, v in (m.get("stageSeconds") or {}).items()
+        }
+        resp.device_dispatches = int(m.get("deviceDispatches", 0))
         return resp

@@ -55,7 +55,7 @@ from tempo_tpu.encoding.vtpu import format as fmt
 from tempo_tpu.model.columnar import ATTR_COLUMNS, SPAN_COLUMNS, VT_STR, SpanBatch
 from tempo_tpu.model.trace import Trace, batch_to_traces
 from tempo_tpu.ops import bloom
-from tempo_tpu.util import metrics
+from tempo_tpu.util import metrics, stagetimings
 
 # columns needed to build TraceSearchMetadata for matching traces
 _META_COLS = ["trace_id", "parent_span_id", "start_unix_nano", "duration_nano", "name", "service"]
@@ -338,7 +338,9 @@ class VtpuBackendBlock:
     # ------------------------------------------------------------------
     def index(self) -> fmt.BlockIndex:
         if self._index is None:
-            raw = self.backend.read_named(self.meta.tenant_id, self.meta.block_id, ColumnIndexName)
+            with stagetimings.stage("fetch"):
+                raw = self.backend.read_named(
+                    self.meta.tenant_id, self.meta.block_id, ColumnIndexName)
             self.bytes_read += len(raw)
             self._index = fmt.BlockIndex.from_bytes(raw)
         return self._index
@@ -365,7 +367,9 @@ class VtpuBackendBlock:
 
     def dictionary(self):
         if self._dict is None:
-            raw = self.backend.read_named(self.meta.tenant_id, self.meta.block_id, DictionaryName)
+            with stagetimings.stage("fetch"):
+                raw = self.backend.read_named(
+                    self.meta.tenant_id, self.meta.block_id, DictionaryName)
             self.bytes_read += len(raw)
             self._dict = fmt.deserialize_dictionary(raw)
         return self._dict
@@ -374,9 +378,12 @@ class VtpuBackendBlock:
         def read(offset, length):
             with self._io_lock:
                 self.bytes_read += length
-            return self.backend.read_range_named(
-                self.meta.tenant_id, self.meta.block_id, DataName, offset, length
-            )
+            # every page read lands in the waterfall's "fetch" bucket
+            # (exclusive: the enclosing "decode" stage subtracts it)
+            with stagetimings.stage("fetch"):
+                return self.backend.read_range_named(
+                    self.meta.tenant_id, self.meta.block_id, DataName, offset, length
+                )
 
         return read
 
@@ -388,7 +395,8 @@ class VtpuBackendBlock:
     def _fetch_columns(self, rg: fmt.RowGroupMeta, names: list[str]) -> dict[str, np.ndarray]:
         """Fetch+decode columns with coalesced ranged reads, accounting
         the round trips saved vs one-read-per-page."""
-        cols, n_reads, _ = fmt.read_columns_coalesced(self._reader(), rg, names)
+        with stagetimings.stage("decode"):  # IO inside lands in "fetch"
+            cols, n_reads, _ = fmt.read_columns_coalesced(self._reader(), rg, names)
         saved = len(names) - n_reads
         if saved > 0:
             with self._io_lock:
@@ -529,15 +537,16 @@ class VtpuBackendBlock:
             end_rg = (start_row_group + row_groups) if row_groups else len(all_rgs)
             zm = zone_maps_enabled()
             live: list = []
-            for rg in all_rgs[start_row_group:end_rg]:
-                if req.start_seconds and rg.end_s < req.start_seconds:
-                    continue
-                if req.end_seconds and rg.start_s > req.end_seconds:
-                    continue
-                if zm and zone_prunes(rg, preds, req):
-                    resp.pruned_row_groups += 1
-                    continue
-                live.append(rg)
+            with stagetimings.stage("zonemap_prune"):
+                for rg in all_rgs[start_row_group:end_rg]:
+                    if req.start_seconds and rg.end_s < req.start_seconds:
+                        continue
+                    if req.end_seconds and rg.start_s > req.end_seconds:
+                        continue
+                    if zm and zone_prunes(rg, preds, req):
+                        resp.pruned_row_groups += 1
+                        continue
+                    live.append(rg)
             if resp.pruned_row_groups:
                 self.pruned_row_groups += resp.pruned_row_groups
                 pruned_row_groups_total.inc(resp.pruned_row_groups)

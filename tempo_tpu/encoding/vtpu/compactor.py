@@ -57,6 +57,7 @@ from tempo_tpu.model.columnar import (
 from tempo_tpu import native
 from tempo_tpu.ops import bloom, merge, sketch
 from tempo_tpu.util.pipeline import ReadAhead, overlap_enabled, prefetch_iter
+from tempo_tpu.util import tracing
 
 # span columns whose values can legitimately differ between RF copies of
 # the same span; trace_id/span_id are the identity key.
@@ -145,9 +146,11 @@ class VtpuCompactor:
                 and not self.opts.max_spans_per_trace):
             from tempo_tpu.parallel.compaction import plan_disjoint_runs
 
-            segments = plan_disjoint_runs(
-                [[(rg.min_id, rg.max_id) for rg in b.index().row_groups] for b in blocks]
-            )
+            with tracing.span("compactor/plan", inputs=len(blocks)):
+                segments = plan_disjoint_runs(
+                    [[(rg.min_id, rg.max_id) for rg in b.index().row_groups]
+                     for b in blocks]
+                )
             if any(s[0] == "relocate" for s in segments):
                 return self._compact_fast(
                     blocks, remaps, segments, tenant, backend, out_dict, level
@@ -181,9 +184,11 @@ class VtpuCompactor:
                     sharded.finish if sharded else sketcher.finish)
         writer = BlockWriter(tenant, backend, cfg, compaction_level=level)
         try:
-            for batch in batches:
-                writer.append_batch(batch)
-            out = writer.finish(sketches=sketches)
+            with tracing.span("compactor/merge", inputs=len(metas)):
+                for batch in batches:
+                    writer.append_batch(batch)
+            with tracing.span("compactor/put"):
+                out = writer.finish(sketches=sketches)
             self.pages_reencoded += writer.pages_reencoded
             self.bytes_reencoded += writer.bytes_reencoded
             if devm is not None:
@@ -253,10 +258,12 @@ class VtpuCompactor:
                     self.max_resident_rows = max(self.max_resident_rows, rg.n_spans)
                     if rg.n_spans >= min_reloc:
                         flush_small()  # held-back rows sort before this group
-                        fallback = self._relocate_row_group(
-                            blocks[bi], remaps[bi], identity[bi], rg, writer,
-                            acc, out_dict,
-                        )
+                        with tracing.span("compactor/relocate",
+                                          spans=int(rg.n_spans)):
+                            fallback = self._relocate_row_group(
+                                blocks[bi], remaps[bi], identity[bi], rg, writer,
+                                acc, out_dict,
+                            )
                         if fallback is None:
                             continue
                         # intra-group duplicate keys (guard tripped): the
@@ -284,9 +291,10 @@ class VtpuCompactor:
                     inner = self._stream_merge(streams, out_dict, None)
                     gen = prefetch_iter(inner, depth=2) if overlap_enabled() else inner
                     try:
-                        for batch in gen:
-                            acc.update(batch)
-                            writer.append_batch(batch)
+                        with tracing.span("compactor/merge", cluster=len(rngs)):
+                            for batch in gen:
+                                acc.update(batch)
+                                writer.append_batch(batch)
                     finally:
                         gen.close()
                         try:
@@ -296,7 +304,8 @@ class VtpuCompactor:
                         for s in streams:
                             s.close()
             flush_small()
-            out = writer.finish(sketches=acc.finish)
+            with tracing.span("compactor/put"):
+                out = writer.finish(sketches=acc.finish)
         finally:
             self.pages_copied_verbatim += writer.pages_copied_verbatim
             self.pages_reencoded += writer.pages_reencoded

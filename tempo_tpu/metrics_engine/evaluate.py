@@ -279,10 +279,13 @@ class DeviceAccumulator(HostAccumulator):
             return
         from tempo_tpu.ops.pallas_kernels import compress_slot_runs, seg_bincount
 
+        from tempo_tpu.util.devicetiming import timed_dispatch
+
         raw = self._buf[0] if len(self._buf) == 1 else np.concatenate(self._buf)
         self._buf, self._buf_rows = [], 0
         slots, weights = compress_slot_runs(raw)
-        self.counts += seg_bincount(slots, self.plan.n_slots, weights=weights)
+        self.counts += timed_dispatch(
+            "seg_bincount", seg_bincount, slots, self.plan.n_slots, weights=weights)
         self.dispatches += 1
 
     def merged_counts(self) -> np.ndarray:

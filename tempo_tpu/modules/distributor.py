@@ -54,35 +54,9 @@ class NoHealthyIngesters(Exception):
     pass
 
 
-class TokenBucket:
-    def __init__(self, rate: float, burst: float):
-        self.rate = rate
-        self.burst = burst
-        self.tokens = burst
-        self.t = time.monotonic()
-        self.last_used = self.t
-        self.lock = threading.Lock()
-
-    def allow_n(self, n: float) -> bool:
-        with self.lock:
-            now = time.monotonic()
-            self.last_used = now
-            self.tokens = min(self.burst, self.tokens + (now - self.t) * self.rate)
-            self.t = now
-            if n <= self.tokens:
-                self.tokens -= n
-                return True
-            return False
-
-    def retry_after_s(self, n: float) -> float:
-        """Seconds until n tokens will have refilled — the Retry-After
-        hint for a rejected request of size n. Deliberately NOT capped
-        at the burst size: a request larger than the burst gets the
-        honest (long) accrual time rather than a zero hint."""
-        with self.lock:
-            if self.rate <= 0:
-                return 1.0
-            return max(0.0, (n - self.tokens) / self.rate)
+# the shared token-bucket primitive (hoisted to util/resource; the name
+# stays importable from here for existing callers/tests)
+TokenBucket = resource.TokenBucket
 
 
 @dataclass
@@ -178,7 +152,7 @@ class Distributor:
     def push_batch(self, tenant: str, batch: SpanBatch) -> None:
         if batch.num_spans == 0:
             return
-        with tracing.span("distributor.PushBatch", tenant=tenant, spans=batch.num_spans):
+        with tracing.span("distributor/push", tenant=tenant, spans=batch.num_spans):
             self._push_batch_traced(tenant, batch)
 
     def _push_batch_traced(self, tenant: str, batch: SpanBatch) -> None:
@@ -235,7 +209,8 @@ class Distributor:
         spans_received.inc(batch.num_spans, tenant=tenant)
         bytes_received.inc(size, tenant=tenant)
 
-        groups = self._group_by_replica(tenant, batch)
+        with tracing.span("distributor/group_by_replica", spans=batch.num_spans):
+            groups = self._group_by_replica(tenant, batch)
         if not groups:
             raise NoHealthyIngesters("no healthy ingesters in the ring")
         errs = []
@@ -246,7 +221,12 @@ class Distributor:
                 errs.append(f"no client for {instance_id}")
                 continue
             try:
-                client.push_segment(tenant, fmt.serialize_batch(sub))
+                # one span per replica push: the replication fan-out is
+                # where a slow/dead ingester shows up (reference:
+                # DoBatch's per-instance spans, distributor.go:389)
+                with tracing.span("distributor/push_replica",
+                                  instance=instance_id, spans=sub.num_spans):
+                    client.push_segment(tenant, fmt.serialize_batch(sub))
             except resource.ResourceExhausted as e:  # ingester refused: overload
                 shed_errs.append(e)
                 errs.append(f"{instance_id}: {e}")

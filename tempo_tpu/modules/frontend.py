@@ -24,7 +24,7 @@ from dataclasses import dataclass
 from tempo_tpu.encoding.common import SearchRequest, SearchResponse, TraceSearchMetadata
 from tempo_tpu.model.trace import combine_traces
 from tempo_tpu.modules.worker import JobBroker, decode_trace_result
-from tempo_tpu.util import metrics, resource
+from tempo_tpu.util import metrics, resource, stagetimings, tracing
 
 log = logging.getLogger(__name__)
 
@@ -116,6 +116,7 @@ class Frontend:
 
     @contextlib.contextmanager
     def _admit(self, tenant: str, est_bytes: int, protected: bool, what: str):
+        _adm_t0 = time.perf_counter()
         est_bytes = max(0, int(est_bytes))
         query_cost_hist.observe(est_bytes, kind=what)
         # the pool bounds RESIDENT bytes, and execution is chunked: at
@@ -170,6 +171,8 @@ class Frontend:
                         f"(~{est_bytes >> 20} MiB to scan) under memory pressure",
                         retry_after_s=self.governor.retry_after_s() * 2,
                     )
+                # gates cleared: what the waterfall calls "admission"
+                stagetimings.add("admission", time.perf_counter() - _adm_t0)
                 yield
             finally:
                 pool.sub(charge)
@@ -210,7 +213,19 @@ class Frontend:
         from tempo_tpu.modules.queue import TooManyRequests
 
         deadline_ts = time.time() + self.cfg.job_timeout_s
-        descs = [{**d, "deadline": deadline_ts} for d in descs]
+        # every descriptor carries (1) the absolute deadline, (2) the
+        # frontend's trace context so the worker's spans join this
+        # query's trace across the broker/process boundary, and (3) the
+        # submit timestamp so the worker can report queue-wait in the
+        # stage waterfall (wall clock: workers may be remote, but they
+        # share the deployment's clock discipline)
+        tp = tracing.current_traceparent()
+        now_ts = time.time()
+        descs = [
+            {**d, "deadline": deadline_ts, "submitted_at": now_ts,
+             **({"traceparent": tp} if tp else {})}
+            for d in descs
+        ]
         groups = []
         try:
             for d in descs:
@@ -252,22 +267,39 @@ class Frontend:
                         JobError(p.error) if p.error is not None
                         else TimeoutError(f"job {p.job_id} timed out")
                     )
+                self._merge_stage_wires(results)
                 return results, terminal_errors
             log.warning(
                 "retrying %d failed query jobs (attempt %d/%d)",
                 len(failed), attempt + 1, self.cfg.max_retries,
             )
             # resubmission gets the same queue-full cleanup as the
-            # initial submit: orphaned retries must not execute waiterless
+            # initial submit: orphaned retries must not execute waiterless.
+            # submitted_at is RE-stamped: a retry's queue_wait must
+            # measure this enqueue, not include the failed attempt's
+            # whole queue+execution time
             groups = []
             try:
                 for grp in failed:
-                    groups.append([self.broker.submit(tenant, grp[0].desc)])
+                    groups.append([self.broker.submit(
+                        tenant, {**grp[0].desc, "submitted_at": time.time()})])
             except TooManyRequests:
                 for g in groups:
                     g[0].desc["deadline"] = time.time() - 1
                 raise
+        self._merge_stage_wires(results)
         return results, terminal_errors
+
+    @staticmethod
+    def _merge_stage_wires(results: list) -> None:
+        """Fold each worker's stage waterfall (riding the job result as
+        "stages") into this query's accumulator — the stage analog of
+        the search/metrics partial merges."""
+        acc = stagetimings.active()
+        if acc is None:
+            return
+        for r in results:
+            acc.merge_wire(r.get("stages"))
 
     def _settle(self, tenant: str, n_shards: int, results: list, errors: list) -> int:
         """Apply the failed-shard budget to a query's terminal errors.
@@ -330,7 +362,10 @@ class Frontend:
                     # the same in-flight-only rule)
                     if len(g) == 1 and g[0].deadline > 0:
                         log.info("hedging slow query job %s", g[0].job_id)
-                        g.append(self.broker.submit(tenant, g[0].desc))
+                        # fresh submitted_at: the hedge's queue_wait is
+                        # its own, not the original's whole lifetime
+                        g.append(self.broker.submit(
+                            tenant, {**g[0].desc, "submitted_at": _time.time()}))
             # bounded slice on one unfinished group's NEWEST member (the
             # hedge, when present, is the likely finisher); the loop
             # re-checks every group each tick
@@ -341,6 +376,14 @@ class Frontend:
     def find_trace_by_id(self, tenant: str, trace_id: bytes):
         """Shard the blockID space + one ingester job; combine partials,
         dedupe spans (reference: newTraceByIDMiddleware frontend.go:97)."""
+        with stagetimings.request() as st:
+            with tracing.span("frontend/find", tenant=tenant,
+                              trace=trace_id.hex()):
+                out = self._find_traced(tenant, trace_id)
+            st.observe("find")
+            return out
+
+    def _find_traced(self, tenant: str, trace_id: bytes):
         hex_id = trace_id.hex()
         bounds = create_block_boundaries(self.cfg.query_shards)
         descs = [{"kind": "find", "trace_id": hex_id, "mode": "ingesters"}]
@@ -369,6 +412,16 @@ class Frontend:
     def search(self, tenant: str, req: SearchRequest) -> SearchResponse:
         """Ingester window job + one job per chunk of backend blocks
         (reference: searchsharding.go:266 backendRequests)."""
+        with stagetimings.request() as st:
+            with tracing.span("frontend/search", tenant=tenant):
+                out = self._search_traced(tenant, req)
+            wire = st.to_wire()
+            out.stage_seconds = wire["stageSeconds"]
+            out.device_dispatches = wire["deviceDispatches"]
+            st.observe("search")
+            return out
+
+    def _search_traced(self, tenant: str, req: SearchRequest) -> SearchResponse:
         if self.overrides is not None:
             max_dur = self.overrides.for_tenant(tenant).max_search_duration_s
             if max_dur and req.start_seconds and req.end_seconds:
@@ -408,9 +461,10 @@ class Frontend:
             results, errors = self._run_jobs(tenant, descs)
         failed = self._settle(tenant, len(descs), results, errors)
         out = SearchResponse()
-        for r in results:
-            if "response" in r:
-                out.merge(SearchResponse.from_dict(r["response"]), limit=req.limit)
+        with stagetimings.stage("merge"):
+            for r in results:
+                if "response" in r:
+                    out.merge(SearchResponse.from_dict(r["response"]), limit=req.limit)
         if failed:
             # degradation contract: whenever status is NOT "partial" the
             # results are bit-identical to a fault-free run; when it is,
@@ -436,6 +490,21 @@ class Frontend:
         segments (the not-yet-flushed tail); block jobs cover flushed
         data, the same disjointness contract the search path uses.
         """
+        with stagetimings.request() as st:
+            with tracing.span("frontend/query_range", tenant=tenant):
+                mat = self._query_range_traced(
+                    tenant, query, start_s, end_s, step_s,
+                    max_series=max_series, exemplars=exemplars)
+            wire = st.to_wire()
+            stats = mat.setdefault("stats", {})
+            stats["stageSeconds"] = wire["stageSeconds"]
+            stats["deviceDispatches"] = wire["deviceDispatches"]
+            st.observe("query_range")
+            return mat
+
+    def _query_range_traced(self, tenant: str, query: str, start_s: int,
+                            end_s: int, step_s: int, max_series: int = 64,
+                            exemplars: int = 0) -> dict:
         from tempo_tpu.metrics_engine import (
             compile_metrics_plan,
             finalize_matrix,
@@ -491,9 +560,10 @@ class Frontend:
         # response partial with an exact failed-shard count
         failed = self._settle(tenant, len(descs), results, errors)
         merged = new_wire()
-        for r in results:
-            off = (int(r.get("start", plan.start_s)) - plan.start_s) // plan.step_s
-            merge_wire(merged, r.get("wire", {}), plan, bin_offset=off)
+        with stagetimings.stage("merge"):
+            for r in results:
+                off = (int(r.get("start", plan.start_s)) - plan.start_s) // plan.step_s
+                merge_wire(merged, r.get("wire", {}), plan, bin_offset=off)
         if len(results) > 1 and merged["stats"].get("seriesDropped"):
             # each shard caps series in its own first-seen order, so a
             # series kept by one shard and dropped by another would read
@@ -512,6 +582,19 @@ class Frontend:
     # ------------------------------------------------------------------
     def traceql(self, tenant: str, query: str, start_s=0, end_s=0, limit=20,
                 stats: dict | None = None):
+        with stagetimings.request() as st:
+            with tracing.span("frontend/traceql", tenant=tenant, q=query):
+                out = self._traceql_traced(tenant, query, start_s, end_s,
+                                           limit, stats)
+            if stats is not None:
+                wire = st.to_wire()
+                stats["stageSeconds"] = wire["stageSeconds"]
+                stats["deviceDispatches"] = wire["deviceDispatches"]
+            st.observe("traceql")
+            return out
+
+    def _traceql_traced(self, tenant: str, query: str, start_s=0, end_s=0,
+                        limit=20, stats: dict | None = None):
         # parse up front: a malformed query is a client error and must
         # fail before any job is sharded or retried (reference: the
         # frontend's search middleware parses before enqueueing)

@@ -23,7 +23,7 @@ import numpy as np
 from tempo_tpu.encoding.vtpu import format as fmt
 from tempo_tpu.model.columnar import SpanBatch
 from tempo_tpu.model.trace import Trace, batch_to_traces, combine_traces
-from tempo_tpu.util import metrics, resource
+from tempo_tpu.util import metrics, resource, tracing
 from tempo_tpu.util.flushqueues import ExclusiveQueues, FlushOp
 
 log = logging.getLogger(__name__)
@@ -107,6 +107,11 @@ class TenantInstance:
         the end (reference: the distributor's multierror per-trace push
         results). A retried segment may duplicate already-applied traces;
         duplicates collapse at query combine and compaction dedupe."""
+        with tracing.span("ingester/append", tenant=self.tenant,
+                          spans=batch.num_spans):
+            self._push_batch_traced(batch, now)
+
+    def _push_batch_traced(self, batch: SpanBatch, now: float | None = None) -> None:
         now = now or time.time()
         lim = self.overrides.for_tenant(self.tenant)
         tid = batch.cols["trace_id"]
@@ -159,6 +164,14 @@ class TenantInstance:
     # -- cuts -----------------------------------------------------------
     def cut_complete_traces(self, now: float | None = None, immediate: bool = False) -> int:
         """Idle traces -> head WAL block (reference: instance.go:240)."""
+        with tracing.span("ingester/cut_traces", tenant=self.tenant,
+                          immediate=immediate) as s:
+            n = self._cut_complete_traces_traced(now, immediate)
+            if s is not None:
+                s.attributes["cut"] = n
+            return n
+
+    def _cut_complete_traces_traced(self, now: float | None, immediate: bool) -> int:
         now = now or time.time()
         cut = []
         with self.lock:
@@ -232,7 +245,11 @@ class TenantInstance:
                 return None
             self._inflight.add(blk.block_id)
         try:
-            meta = self.db.write_wal_block(self.tenant, blk, block_id=blk.block_id)
+            # the flush span covers merge-sort + encode + backend PUT
+            # (reference: CompleteBlock's span, flush.go:298)
+            with tracing.span("ingester/complete_block", tenant=self.tenant,
+                              block=str(blk.block_id)):
+                meta = self.db.write_wal_block(self.tenant, blk, block_id=blk.block_id)
         except BaseException:
             with self.lock:
                 self._inflight.discard(blk.block_id)
@@ -436,14 +453,18 @@ class Ingester:
         cut_now = immediate or under_pressure
         with self.lock:
             instances = list(self.instances.values())
-        for inst in instances:
-            inst.cut_complete_traces(immediate=cut_now)
-            inst.cut_block_if_ready(immediate=cut_now)
-            if immediate or not self._flush_threads:
-                inst.complete_and_flush()
-            else:
-                self._enqueue_flush_ops(inst)
-            inst.clear_flushed_blocks()
+        # one trace per sweep: the cut/flush spans below land as its
+        # children, so "why did the sweep take 4s" reads as a waterfall
+        with tracing.span("ingester/sweep", instance=self.instance_id,
+                          immediate=immediate, tenants=len(instances)):
+            for inst in instances:
+                inst.cut_complete_traces(immediate=cut_now)
+                inst.cut_block_if_ready(immediate=cut_now)
+                if immediate or not self._flush_threads:
+                    inst.complete_and_flush()
+                else:
+                    self._enqueue_flush_ops(inst)
+                inst.clear_flushed_blocks()
 
     def _enqueue_flush_ops(self, inst: TenantInstance) -> None:
         with inst.lock:

@@ -33,7 +33,7 @@ import weakref
 
 from tempo_tpu.encoding.common import SearchRequest, SearchResponse
 from tempo_tpu.modules.queue import RequestQueue
-from tempo_tpu.util import deadline, metrics
+from tempo_tpu.util import deadline, metrics, stagetimings, tracing
 
 log = logging.getLogger(__name__)
 
@@ -60,9 +60,35 @@ def execute_job(querier, tenant: str, desc: dict) -> dict:
     """Run one descriptor inside its deadline scope: the frontend stamps
     every desc with an absolute `deadline` (util/deadline.py), so every
     backend read below bounds its timeouts by the remaining budget and a
-    job whose requester already gave up stops consuming work."""
+    job whose requester already gave up stops consuming work.
+
+    Observability: the desc also carries the frontend's `traceparent`
+    (worker spans join the query's trace across the broker boundary)
+    and `submitted_at` (queue-wait). The job runs under its OWN
+    StageTimings accumulator — worker threads don't share the
+    frontend's context — and the waterfall travels back in the result
+    as "stages", where the frontend merges it shard-wise. Execution
+    time no stage claimed lands in "other", so the buckets sum to the
+    job's wall clock instead of silently under-reporting."""
     with deadline.scope(desc.get("deadline")):
-        return _execute_job(querier, tenant, desc)
+        with stagetimings.request() as st:
+            queue_wait = 0.0
+            sub = desc.get("submitted_at")
+            if sub:
+                queue_wait = max(0.0, time.time() - float(sub))
+                st.add("queue_wait", queue_wait)
+            t0 = time.perf_counter()
+            try:
+                with tracing.remote_context(desc.get("traceparent")):
+                    with tracing.span(f"worker/{desc.get('kind')}", tenant=tenant):
+                        out = _execute_job(querier, tenant, desc)
+            finally:
+                exec_dt = time.perf_counter() - t0
+                staged = st.total() - queue_wait
+                st.add("other", max(0.0, exec_dt - staged))
+            if isinstance(out, dict):
+                out["stages"] = st.to_wire()
+            return out
 
 
 def _execute_job(querier, tenant: str, desc: dict) -> dict:

@@ -122,8 +122,11 @@ class MeshMetricsEvaluator:
             for i, (s, w) in enumerate(pending):
                 stacked[i, : len(s)] = s
                 wstack[i, : len(s)] = w if w is not None else 1
+            from tempo_tpu.util.devicetiming import timed_dispatch
+
             with _dispatch_lock:
-                out = scan(
+                out = timed_dispatch(
+                    "mesh_bincount", scan,
                     jnp.asarray(stacked.reshape(self.w, self.r, pad)),
                     jnp.asarray(wstack.reshape(self.w, self.r, pad)),
                 )

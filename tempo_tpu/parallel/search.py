@@ -29,6 +29,7 @@ from jax.sharding import PartitionSpec as P
 
 from tempo_tpu.ops import bloom
 from tempo_tpu.parallel.mesh import RANGE_AXIS, WINDOW_AXIS, shard_map_compat
+from tempo_tpu.util.devicetiming import timed_dispatch
 
 # Serializes mesh-program dispatch across threads. Collective programs
 # (psum inside shard_map) need every participating device to run the
@@ -411,7 +412,8 @@ class MeshSearcher:
                     live.append(s)
                 scan = make_sharded_rle_scan(self.mesh, n_cols, self.max_codes, pad)
                 with _dispatch_lock:
-                    masks, _totals = scan(
+                    masks, _totals = timed_dispatch(
+                        "mesh_rle_scan", scan,
                         jnp.asarray(values.reshape(self.w, self.r, n_cols, run_pad)),
                         jnp.asarray(lengths.reshape(self.w, self.r, n_cols, run_pad)),
                         jnp.asarray(codes.reshape(self.w, self.r, n_cols, self.max_codes)),
@@ -441,7 +443,8 @@ class MeshSearcher:
                     valid[s, : rg.n_spans] = True
                     live.append(s)
                 with _dispatch_lock:
-                    masks, _totals = scan(
+                    masks, _totals = timed_dispatch(
+                        "mesh_scan", scan,
                         jnp.asarray(cols.reshape(self.w, self.r, n_cols, pad)),
                         jnp.asarray(codes.reshape(self.w, self.r, n_cols, self.max_codes)),
                         jnp.asarray(valid.reshape(self.w, self.r, pad)),

@@ -248,10 +248,22 @@ class TraceGrpcServer:
 
     def _ingest(self, traces, context):
         from tempo_tpu.modules.distributor import RateLimited
+        from tempo_tpu.util import tracing
         from tempo_tpu.util.resource import ResourceExhausted
 
+        # trace-context extraction from gRPC metadata (reference: the
+        # receiver shim's otelgrpc interceptor): the same W3C
+        # traceparent key OTel gRPC clients send
+        tp = None
+        for k, v in context.invocation_metadata():
+            if k.lower() == tracing.TRACEPARENT_HEADER:
+                tp = v
+                break
+        n_spans = sum(t.span_count() for t in traces)
         try:
-            self._push(traces, org_id=self._org_id(context))
+            with tracing.remote_context(tp):
+                with tracing.span("grpc/export", spans=n_spans):
+                    self._push(traces, org_id=self._org_id(context))
         except (RateLimited, ResourceExhausted) as e:
             # the gRPC analog of the HTTP 429 + Retry-After translation:
             # RESOURCE_EXHAUSTED with a RetryInfo detail in the standard
@@ -272,7 +284,7 @@ class TraceGrpcServer:
             log.exception("grpc ingest failed")
             context.abort(self._grpc.StatusCode.INTERNAL, str(e))
         self.requests += 1
-        self.spans += sum(t.span_count() for t in traces)
+        self.spans += n_spans
 
     def _export_otlp(self, request: bytes, context) -> bytes:
         try:

@@ -1,0 +1,61 @@
+"""Device dispatch timing: the per-kernel half of the waterfall.
+
+The ROADMAP's recurring finding is the per-op device round trip tax —
+but until now nothing MEASURED it per dispatch in production. Every
+host-level pallas/mesh dispatch site wraps its call in
+`timed_dispatch(label, fn, ...)`: wall clock around the call INCLUDING
+`jax.block_until_ready` (an async dispatch that hasn't materialized
+hasn't been paid for yet), published to
+
+  tempo_tpu_device_dispatch_seconds{kernel="..."}   histogram
+  tempo_tpu_device_dispatches_total{kernel="..."}   counter
+
+and, when a query's StageTimings accumulator is active, folded into its
+`kernel` stage + dispatch count — so a slow p99 can be blamed on device
+time from either the dashboard or a single response's waterfall.
+
+Only call this at HOST level (outside jit): inside a traced program
+there is no wall clock to read.
+"""
+
+from __future__ import annotations
+
+import time
+
+from tempo_tpu.util import metrics, stagetimings
+
+dispatch_hist = metrics.histogram(
+    "tempo_tpu_device_dispatch_seconds",
+    "Wall-clock seconds per host-level device dispatch, by kernel label "
+    "(includes transfer + compile-cache lookup + block_until_ready)",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0, 2.5, 5.0, 10.0),
+)
+dispatch_total = metrics.counter(
+    "tempo_tpu_device_dispatches_total",
+    "Host-level device dispatches, by kernel label",
+)
+
+
+def timed_dispatch(kernel: str, fn, *args, **kwargs):
+    """Run one host-level device dispatch under the timing plane.
+
+    Returns fn's result after block_until_ready. Timing failures never
+    mask the dispatch's own result or error."""
+    t0 = time.perf_counter()
+    try:
+        out = fn(*args, **kwargs)
+        import jax
+
+        # never raises for plain numpy/scalar/pytree results, so any
+        # exception here is a REAL device failure (faulted kernel, OOM)
+        # and must propagate with this dispatch's attribution — the
+        # finally still records the attempt's wall clock
+        jax.block_until_ready(out)
+        return out
+    finally:
+        dt = time.perf_counter() - t0
+        dispatch_hist.observe(dt, kernel=kernel)
+        dispatch_total.inc(kernel=kernel)
+        stagetimings.add("kernel", dt)
+        stagetimings.count_dispatch()

@@ -6,19 +6,33 @@ samples every live thread's stack via sys._current_frames() at a fixed
 rate for a bounded window and aggregates frame hit counts — the same
 shape of answer a pprof CPU profile gives ("where is time going right
 now"), with no interpreter-wide tracing overhead while idle.
+
+Two output formats:
+- text (default): human-readable hottest frames + hottest stacks;
+- collapsed: one `frame;frame;...;frame count` line per distinct stack
+  (Brendan Gregg's folded format), so the output pipes straight into
+  flamegraph.pl / speedscope / inferno without any conversion.
+
+capture_device_profile() is the accelerator-side analog: a bounded
+jax.profiler trace window for the /status/profile/device endpoint.
 """
 
 from __future__ import annotations
 
+import os
 import sys
+import tempfile
 import threading
 import time
 from collections import Counter
 
+_STACK_DEPTH = 64
 
-def sample_profile(seconds: float = 5.0, hz: int = 100, top: int = 40) -> str:
-    """Sample all thread stacks for `seconds`; returns a text report of
-    the hottest frames and the hottest whole stacks."""
+
+def _sample(seconds: float, hz: int):
+    """(frame_hits, stack_hits, samples): stack_hits keys are FULL
+    root->leaf semicolon-joined stacks (collapsed format needs the whole
+    stack; the text report truncates for display)."""
     seconds = max(0.1, min(float(seconds), 60.0))
     interval = 1.0 / max(1, min(int(hz), 1000))
     me = threading.get_ident()
@@ -32,7 +46,7 @@ def sample_profile(seconds: float = 5.0, hz: int = 100, top: int = 40) -> str:
                 continue
             stack = []
             f = frame
-            while f is not None and len(stack) < 30:
+            while f is not None and len(stack) < _STACK_DEPTH:
                 co = f.f_code
                 entry = f"{co.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno}:{co.co_name}"
                 stack.append(entry)
@@ -40,15 +54,95 @@ def sample_profile(seconds: float = 5.0, hz: int = 100, top: int = 40) -> str:
             if not stack:
                 continue
             frame_hits[stack[0]] += 1
-            stack_hits[";".join(reversed(stack[:10]))] += 1
+            stack_hits[";".join(reversed(stack))] += 1
             samples += 1
         time.sleep(interval)
+    return frame_hits, stack_hits, samples
 
+
+def sample_profile(seconds: float = 5.0, hz: int = 100, top: int = 40,
+                   fmt: str = "text") -> str:
+    """Sample all thread stacks for `seconds`.
+
+    fmt="text": report of the hottest frames and hottest whole stacks.
+    fmt="collapsed": semicolon-folded stacks with sample counts, one
+    line each — standard flamegraph input."""
+    frame_hits, stack_hits, samples = _sample(seconds, hz)
+    if fmt == "collapsed":
+        lines = [f"{stack} {n}" for stack, n in sorted(stack_hits.items())]
+        return "\n".join(lines) + ("\n" if lines else "")
     lines = [f"# sampling profile: {seconds:.1f}s @ {hz}Hz, {samples} thread-samples"]
     lines.append("\n## hottest frames (leaf)")
     for entry, n in frame_hits.most_common(top):
         lines.append(f"{n:6d}  {entry}")
     lines.append("\n## hottest stacks (root->leaf, truncated)")
     for stack, n in stack_hits.most_common(10):
-        lines.append(f"{n:6d}  {stack}")
+        parts = stack.split(";")
+        shown = ";".join(parts[:10])
+        lines.append(f"{n:6d}  {shown}")
     return "\n".join(lines) + "\n"
+
+
+_DEVICE_PROFILE_PREFIX = "tempo-tpu-device-profile-"
+_DEVICE_PROFILE_KEEP = 3
+
+
+def _prune_device_profiles(keep: int = _DEVICE_PROFILE_KEEP) -> None:
+    """Captures are per-request artifacts on a long-lived server: keep
+    only the newest few so a dashboard probe hammering the endpoint
+    can't fill the disk with profiler traces."""
+    root = tempfile.gettempdir()
+    try:
+        dirs = sorted(
+            (os.path.join(root, n) for n in os.listdir(root)
+             if n.startswith(_DEVICE_PROFILE_PREFIX)),
+            key=lambda p: os.path.getmtime(p),
+        )
+    except OSError:
+        return
+    import shutil
+
+    for stale in dirs[:-keep] if keep else dirs:
+        shutil.rmtree(stale, ignore_errors=True)
+
+
+def capture_device_profile(seconds: float = 1.0, out_dir: str | None = None) -> dict:
+    """Bounded jax.profiler capture: traces whatever device work runs in
+    the window into a TensorBoard-loadable directory. Degrades honestly —
+    {"supported": False, "error": ...} when the backend/profiler can't —
+    because an admin endpoint that 500s under the exact conditions it
+    exists to debug is worse than useless."""
+    seconds = max(0.1, min(float(seconds), 30.0))
+    try:
+        import jax
+        import jax.profiler  # noqa: F401
+    except Exception as e:  # pragma: no cover - jax is baked in
+        return {"supported": False, "error": f"jax unavailable: {e}"}
+    if out_dir is None:
+        # mkdtemp: unique under rapid successive captures (a wall-clock
+        # suffix collides within one second); old captures are pruned
+        out_dir = tempfile.mkdtemp(prefix=_DEVICE_PROFILE_PREFIX)
+        _prune_device_profiles()
+    try:
+        jax.profiler.start_trace(out_dir)
+    except Exception as e:
+        return {"supported": False, "error": f"profiler start failed: {e}"}
+    try:
+        time.sleep(seconds)
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            return {"supported": False, "error": f"profiler stop failed: {e}",
+                    "dir": out_dir}
+    files = []
+    for root, _dirs, names in os.walk(out_dir):
+        for n in names:
+            files.append(os.path.relpath(os.path.join(root, n), out_dir))
+    return {
+        "supported": True,
+        "seconds": seconds,
+        "dir": out_dir,
+        "files": sorted(files)[:200],
+        "hint": "load with TensorBoard's profile plugin or xprof",
+    }

@@ -64,6 +64,41 @@ pool_limit_gauge = metrics.gauge(
 rss_gauge = metrics.gauge("tempo_tpu_process_rss_bytes", "Sampled process RSS")
 
 
+class TokenBucket:
+    """The stack's one token-bucket: per-tenant ingest limiters
+    (modules/distributor) and the self-tracing export bound
+    (util/tracing.SelfTraceExporter) share this arithmetic."""
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = rate
+        self.burst = burst
+        self.tokens = burst
+        self.t = time.monotonic()
+        self.last_used = self.t
+        self.lock = threading.Lock()
+
+    def allow_n(self, n: float) -> bool:
+        with self.lock:
+            now = time.monotonic()
+            self.last_used = now
+            self.tokens = min(self.burst, self.tokens + (now - self.t) * self.rate)
+            self.t = now
+            if n <= self.tokens:
+                self.tokens -= n
+                return True
+            return False
+
+    def retry_after_s(self, n: float) -> float:
+        """Seconds until n tokens will have refilled — the Retry-After
+        hint for a rejected request of size n. Deliberately NOT capped
+        at the burst size: a request larger than the burst gets the
+        honest (long) accrual time rather than a zero hint."""
+        with self.lock:
+            if self.rate <= 0:
+                return 1.0
+            return max(0.0, (n - self.tokens) / self.rate)
+
+
 class ResourceExhausted(Exception):
     """Shed: the process (or one of its pools) is over budget. Carries a
     retry hint — HTTP surfaces it as Retry-After, gRPC as RetryInfo."""

@@ -63,6 +63,23 @@ def test_probe_skipped_when_cpu_pinned(monkeypatch):
     assert bench._probe_accelerator(0.1) is True
 
 
+def test_self_tracing_guard_refuses(monkeypatch):
+    """Perf reps must never include dogfood traffic: an installed
+    self-tracing exporter makes bench refuse up front (same contract as
+    the TEMPO_TPU_FAULTS guard)."""
+    from tempo_tpu.util import tracing
+
+    monkeypatch.setattr(sys, "argv", ["bench.py"])
+    monkeypatch.delenv("TEMPO_TPU_FAULTS", raising=False)
+    tracing.install_exporter(lambda traces: None)
+    try:
+        with pytest.raises(SystemExit) as e:
+            bench.main()
+        assert e.value.code == 2
+    finally:
+        tracing.TRACER.exporter = None
+
+
 def test_midrun_crash_emits_artifact(monkeypatch, capsys):
     """Any exception after the watchdog starts must still produce one
     parseable JSON line with value:null + error, and exit nonzero."""

@@ -1,0 +1,135 @@
+"""Metrics hygiene lint (tier-1): scrape a booted single-binary app's
+/metrics and fail on exposition rot — empty help text, duplicate
+registration, malformed family names, bad label names.
+
+The reference enforces this socially (promtool lint in CI + naming
+conventions in review); here the rules are executable so a PR that adds
+`tempo_foo-bar` or help-less metrics fails before it merges:
+
+- family names match  tempo(db|_tpu)?_[a-z0-9_]+
+- every family has non-empty HELP
+- no family declares TYPE twice (duplicate registration)
+- label names match the Prometheus data model
+- sample lines belong to a declared family (histograms may emit
+  _bucket/_sum/_count; counters emit their own name)
+"""
+
+import re
+import urllib.request
+
+import pytest
+
+from tempo_tpu.app import App, AppConfig
+from tempo_tpu.api.server import TempoServer
+from tempo_tpu.db import DBConfig
+
+NAME_RE = re.compile(r"tempo(db|_tpu)?_[a-z0-9_]+\Z")
+LABEL_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*\Z")
+SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)$"
+)
+LABEL_PAIR_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+@pytest.fixture(scope="module")
+def exposition(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("hygiene")
+    app = App(AppConfig(
+        db=DBConfig(backend="local", backend_path=str(tmp / "blocks"),
+                    wal_path=str(tmp / "wal")),
+        generator_enabled=False,
+    ))
+    srv = TempoServer(app).start()
+    try:
+        with urllib.request.urlopen(srv.url + "/metrics") as r:
+            assert r.status == 200
+            yield r.read().decode()
+    finally:
+        srv.stop()
+        app.shutdown()
+
+
+def _parse(text):
+    helps: dict[str, str] = {}
+    types: list[tuple[str, str]] = []
+    samples: list[tuple[str, str]] = []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):]
+            name, _, help_ = rest.partition(" ")
+            helps[name] = help_
+        elif line.startswith("# TYPE "):
+            rest = line[len("# TYPE "):]
+            name, _, kind = rest.partition(" ")
+            types.append((name, kind.strip()))
+        elif line.startswith("#"):
+            continue
+        else:
+            m = SAMPLE_RE.match(line)
+            assert m, f"unparseable sample line: {line!r}"
+            samples.append((m.group(1), m.group(3) or ""))
+    return helps, types, samples
+
+
+def test_family_names_match_convention(exposition):
+    helps, types, _ = _parse(exposition)
+    bad = [n for n, _ in types if not NAME_RE.fullmatch(n)]
+    assert not bad, f"metric names outside tempo(db|_tpu)?_* convention: {bad}"
+
+
+def test_no_empty_help(exposition):
+    helps, types, _ = _parse(exposition)
+    missing = [n for n, _ in types if not helps.get(n, "").strip()]
+    assert not missing, f"metrics with empty help text: {missing}"
+
+
+def test_no_duplicate_registration(exposition):
+    _, types, _ = _parse(exposition)
+    seen: set = set()
+    dups = []
+    for name, _kind in types:
+        if name in seen:
+            dups.append(name)
+        seen.add(name)
+    assert not dups, f"families declared twice: {dups}"
+
+
+def test_samples_belong_to_declared_families(exposition):
+    _, types, samples = _parse(exposition)
+    families = {n for n, _ in types}
+    kinds = dict(types)
+    allowed: set = set()
+    for name in families:
+        allowed.add(name)
+        if kinds[name] == "histogram":
+            allowed.update({f"{name}_bucket", f"{name}_sum", f"{name}_count"})
+    orphans = sorted({n for n, _ in samples if n not in allowed})
+    assert not orphans, f"sample lines with no declared family: {orphans}"
+
+
+def test_label_names_valid(exposition):
+    _, _, samples = _parse(exposition)
+    bad = []
+    for name, labelstr in samples:
+        if not labelstr:
+            continue
+        for lname, _v in LABEL_PAIR_RE.findall(labelstr):
+            if not LABEL_RE.fullmatch(lname) or lname.startswith("__"):
+                bad.append((name, lname))
+    assert not bad, f"invalid label names: {bad}"
+
+
+def test_registry_wide_help_nonempty():
+    """Belt-and-braces beyond the scrape: any metric object anywhere in
+    the process registry (including ones with no samples yet) must carry
+    help text and a conventional name."""
+    from tempo_tpu.util.metrics import REGISTRY
+
+    with REGISTRY._lock:
+        metrics = dict(REGISTRY._metrics)
+    no_help = [n for n, m in metrics.items() if not getattr(m, "help", "").strip()]
+    bad_name = [n for n in metrics if not NAME_RE.fullmatch(n)]
+    assert not no_help, f"registered metrics with empty help: {no_help}"
+    assert not bad_name, f"registered metrics violating naming: {bad_name}"
