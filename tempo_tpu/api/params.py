@@ -22,6 +22,7 @@ PATH_SEARCH = "/api/search"
 PATH_SEARCH_TAGS = "/api/search/tags"
 PATH_SEARCH_TAG_VALUES = "/api/search/tag"  # + /{name}/values
 PATH_METRICS_QUERY_RANGE = "/api/metrics/query_range"
+PATH_USAGE = "/api/usage"  # tenant-scoped cost rollup
 PATH_ECHO = "/api/echo"
 
 _DUR_RE = re.compile(r"([0-9]*\.?[0-9]+)(ns|us|µs|ms|s|m|h)")

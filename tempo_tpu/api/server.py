@@ -278,7 +278,14 @@ class _Handler(BaseHTTPRequestHandler):
             if rpc is None:
                 self._send_error(404, "no rpc surface")
                 return 404
-            tenant = app.resolve_tenant(self._org_id())
+            if path.startswith("/rpc/v1/worker/"):
+                # worker pull/result are tenant-less by design: a querier
+                # serves EVERY tenant's jobs and each job descriptor
+                # carries its own tenant — requiring an org id here would
+                # 401 the long-poll the moment multitenancy turns on
+                tenant = ""
+            else:
+                tenant = app.resolve_tenant(self._org_id())
             code, ctype, payload = rpc.handle(method, path, tenant, self._body())
             self._send(code, payload, ctype)
             return code
@@ -332,6 +339,21 @@ class _Handler(BaseHTTPRequestHandler):
         if path.startswith(api_params.PATH_SEARCH_TAG_VALUES + "/") and path.endswith("/values"):
             tag = unquote(path[len(api_params.PATH_SEARCH_TAG_VALUES) + 1 : -len("/values")])
             self._send_json(200, {"tagValues": app.search_tag_values(tag, org_id=self._org_id())})
+            return 200
+        if path == api_params.PATH_USAGE:
+            # tenant-scoped cost rollup (reference: the per-tenant usage
+            # trackers in modules/overrides + distributor usage metrics):
+            # a tenant sees ONLY its own vectors — the same numbers the
+            # tempo_tpu_usage_*_total{tenant=...} counters report
+            from tempo_tpu.util import usage as usage_mod
+
+            tenant = app.resolve_tenant(self._org_id())
+            doc = usage_mod.usage_report(tenant).get("tenants", {}).get(tenant, {})
+            self._send_json(200, {
+                "tenant": tenant,
+                "kinds": doc.get("kinds", {}),
+                "total": doc.get("total", {}),
+            })
             return 200
         if path == api_params.PATH_ECHO:
             self._send(200, b"echo", "text/plain; charset=utf-8")
@@ -457,6 +479,30 @@ class _Handler(BaseHTTPRequestHandler):
             return 200
         if path == "/status/services":
             self._send_json(200, app.service_states() if hasattr(app, "service_states") else {"app": "Running"})
+            return 200
+        if path == "/status/usage":
+            # operator view: every tenant's cost vectors (the admin-side
+            # complement of the tenant-scoped /api/usage)
+            from tempo_tpu.util import usage as usage_mod
+
+            self._send_json(200, usage_mod.usage_report())
+            return 200
+        if path == "/status/storage":
+            # storage-health rollup (reference: tempo-cli analyse blocks,
+            # served live): codec mix + compression, zone-map coverage,
+            # compaction debt/payoff per tenant. Served from the periodic
+            # scanner's last pass when fresh; ?refresh=1 forces a scan.
+            db = app.db
+            if db is None:
+                raise RoleUnavailable(
+                    f"this process (target={app.target}) has no storage engine")
+            scanner = getattr(app, "storage_scanner", None)
+            if scanner is None:
+                from tempo_tpu.db.analytics import StorageScanner
+
+                scanner = app.storage_scanner = StorageScanner(db)
+            refresh = qs.get("refresh", ["0"])[0] not in ("0", "", "false")
+            self._send_json(200, scanner.report(max_age_s=0 if refresh else None))
             return 200
         if path == "/status/usage-stats":
             # current anonymous usage report (reference: PathUsageStats,
@@ -618,6 +664,7 @@ _ENDPOINTS = [
     "GET /api/search/tags",
     "GET /api/search/tag/{name}/values",
     "GET /api/metrics/query_range",
+    "GET /api/usage",
     "GET /api/echo",
     "GET /ready",
     "GET /metrics",
@@ -628,7 +675,9 @@ _ENDPOINTS = [
     "GET /status/endpoints",
     "GET /status/profile",
     "GET /status/profile/device",
+    "GET /status/usage",
     "GET /status/usage-stats",
+    "GET /status/storage",
     "GET /status/runtime_config",
     "POST /flush",
     "POST /shutdown",

@@ -136,6 +136,7 @@ class App:
         self.forwarder_manager = None
         self.remote_write_storage = None
         self.usage_reporter = None
+        self.storage_scanner = None
         self.rpc = None
         self._heartbeat_stops = []
         self._registered: list = []  # (ring, instance_id) to unregister on shutdown
@@ -154,6 +155,7 @@ class App:
         else:
             self._build_role(target)
         self._maybe_self_tracing()
+        self._maybe_storage_scanner()
 
     # ------------------------------------------------------------------
     def _hb_period(self) -> float:
@@ -380,12 +382,51 @@ class App:
             push, cfg, governor=self.governor)
         tracing.install_exporter(self._self_exporter, cfg.service_name)
 
+    def _maybe_storage_scanner(self):
+        """Storage-health analytics (db/analytics): the periodic scan
+        runs on compaction-owning roles — one fleet scanner per
+        deployment, beside the one compactor that creates the debt it
+        measures. /status/storage on any db-holding role still computes
+        on demand."""
+        if self.db is None or self.target not in ("all", "compactor"):
+            return
+        if self.cfg.db.analytics_scan_s <= 0:
+            return
+        from tempo_tpu.db.analytics import StorageScanner
+
+        self.storage_scanner = StorageScanner(
+            self.db, interval_s=self.cfg.db.analytics_scan_s)
+
     def _maybe_usage_reporter(self):
         cfg = self.cfg
         if cfg.usage_stats is not None and getattr(cfg.usage_stats, "enabled", False):
             from tempo_tpu.usagestats import Reporter
 
             self.usage_reporter = Reporter(cfg.usage_stats, self.db.backend.raw)
+            self.usage_reporter.register_provider(self._storage_scale_stats)
+
+    def _storage_scale_stats(self) -> dict:
+        """Feature/scale stats for the anonymous usage snapshot
+        (reference: pkg/usagestats Edge/Target entries) — fleet-level
+        storage health, NEVER tenant names: block counts, bytes, codec
+        mix, compression ratio from the analytics scanner's last pass."""
+        scanner = self.storage_scanner
+        last = scanner.last_report() if scanner is not None else None
+        if last is None:
+            return {}
+        fleet = last["fleet"]
+        out = {
+            "storage_blocks": fleet["blocks"],
+            "storage_total_bytes": fleet["totalBytes"],
+            "storage_total_spans": fleet["totalSpans"],
+            "storage_compression_ratio": fleet["compressionRatio"],
+            "storage_zonemap_coverage_ratio": fleet["zonemapCoverageRatio"],
+            "storage_compaction_debt_row_groups": fleet["compactionDebtRowGroups"],
+            "storage_compaction_debt_payoff": fleet["compactionDebtPayoff"],
+        }
+        for codec, pages in fleet["codecPages"].items():
+            out[f"storage_codec_pages_{codec}"] = pages
+        return out
 
     # -- tenant resolution ----------------------------------------------
     def resolve_tenant(self, org_id: str | None) -> str:
@@ -459,6 +500,8 @@ class App:
             self.remote_write_storage.start_loop(self.generator)
         if self.usage_reporter is not None:
             self.usage_reporter.start_loop()
+        if self.storage_scanner is not None:
+            self.storage_scanner.start()
 
     def sweep_all(self, immediate: bool = False):
         """Deterministic maintenance for tests/drives."""
@@ -510,5 +553,7 @@ class App:
             self.forwarder_manager.stop()
         if self.usage_reporter is not None:
             self.usage_reporter.stop()
+        if self.storage_scanner is not None:
+            self.storage_scanner.stop()
         if self.db is not None:
             self.db.shutdown()

@@ -18,6 +18,7 @@ from dataclasses import dataclass
 
 from tempo_tpu.backend.base import RawBackend
 from tempo_tpu.cache import Cache
+from tempo_tpu.util import usage
 
 
 @dataclass
@@ -64,7 +65,9 @@ class CachedBackend(RawBackend):
         key = self._key(name, keypath)
         _, bufs, missed = self.cache.fetch([key])
         if not missed:
+            usage.charge("cache_hits")
             return bufs[0]
+        usage.charge("cache_misses")
         data = self.inner.read(name, keypath)
         if len(data) <= self.ctl.max_cacheable_bytes:
             self.cache.store([key], [data])
@@ -76,7 +79,9 @@ class CachedBackend(RawBackend):
         key = f"{self._key(name, keypath)}:{offset}:{length}"
         _, bufs, missed = self.cache.fetch([key])
         if not missed:
+            usage.charge("cache_hits")
             return bufs[0]
+        usage.charge("cache_misses")
         data = self.inner.read_range(name, keypath, offset, length)
         if len(data) <= self.ctl.max_cacheable_bytes:
             self.cache.store([key], [data])

@@ -291,6 +291,77 @@ def cmd_list_cache_summary(args) -> int:
     return 0
 
 
+# -- analyse ---------------------------------------------------------------
+
+
+def cmd_analyse_block(args) -> int:
+    """Per-column bytes / compression by codec + zone-map coverage for
+    one block (reference: tempo-cli analyse block)."""
+    from tempo_tpu.db import analytics
+
+    be = _backend(args)
+    meta = be.block_meta(args.tenant, args.block)
+    a = analytics.analyse_block(be, meta)
+    if args.json:
+        print(json.dumps({k: v for k, v in a.items() if k != "rgRanges"}, indent=2))
+        return 0
+    if not a.get("supported"):
+        print(f"block {args.block} ({a['version']}) has no analysable index; "
+              "meta-only facts:")
+        print(json.dumps(a, indent=2))
+        return 0
+    print(f"block {a['blockID']}  level={a['compactionLevel']}  "
+          f"rowGroups={a['rowGroups']}  spans={a['totalSpans']:,}")
+    rows = [
+        [name, f"{c['storedBytes']:,}", f"{c['rawBytes']:,}", f"{c['ratio']:.3f}",
+         ",".join(f"{k}:{v}" for k, v in sorted(c["codecs"].items()))]
+        for name, c in a["columns"].items()
+    ]
+    _print_table(rows, ["column", "stored", "raw", "ratio", "codec pages"])
+    z = a["zonemap"]
+    print(f"\ncompression: {a['storedBytes']:,} / {a['rawBytes']:,} "
+          f"= {a['compressionRatio']:.3f}")
+    print(f"zone maps: {z['rowGroupsWithStats']}/{a['rowGroups']} row groups "
+          f"({z['coverageRatio']:.0%} coverage, "
+          f"{z['statsColumnsPerRowGroup']} stats columns/rg)")
+    return 0
+
+
+def cmd_analyse_blocks(args) -> int:
+    """Tenant rollup: codec mix, compression, zone-map coverage, block
+    age/size distributions, compaction debt (reference: tempo-cli
+    analyse blocks, plus the sweep-scheduler payoff signals)."""
+    from tempo_tpu.db import analytics
+
+    be = _backend(args)
+    metas, _ = _tenant_metas(be, args.tenant)
+    # a bare TypedBackend suffices: metas and window_s are explicit, so
+    # analyse_tenant never touches the db-only members
+    r = analytics.analyse_tenant(be, args.tenant, metas=metas,
+                                 window_s=args.window_s)
+    if args.json:
+        print(json.dumps(r, indent=2))
+        return 0
+    print(f"tenant {r['tenant']}: {r['blocks']} blocks "
+          f"({r['analysedBlocks']} analysed), {r['totalBytes']:,} bytes, "
+          f"{r['totalSpans']:,} spans, levels {r['levels']}")
+    rows = [[c, n, f"{r['codecStoredBytes'].get(c, 0):,}"]
+            for c, n in sorted(r["codecPages"].items())]
+    _print_table(rows, ["codec", "pages", "stored bytes"])
+    z = r["zonemap"]
+    debt = r["compactionDebt"]
+    print(f"\ncompression ratio: {r['compressionRatio']:.3f}")
+    print(f"zone-map coverage: {z['rowGroupsWithStats']}/{z['rowGroups']} "
+          f"row groups ({z['coverageRatio']:.0%})")
+    print(f"compaction debt: {debt['mergeRowGroups']}/{debt['totalRowGroups']} "
+          f"row groups overlap ({debt['debtRatio']:.0%}); payoff={debt['payoff']}")
+    for w in debt["windows"][:5]:
+        print(f"  window {w['window']}: {w['blocks']} blocks, "
+              f"{w['mergeRowGroups']}/{w['rowGroups']} overlapping rgs, "
+              f"zonemap density {w['zonemapDensity']:.0%}, payoff={w['payoff']}")
+    return 0
+
+
 # -- gen -------------------------------------------------------------------
 
 
@@ -424,6 +495,21 @@ def build_parser() -> argparse.ArgumentParser:
     qs.add_argument("--q", default="", help="TraceQL query")
     qs.add_argument("--limit", type=int, default=20)
     qs.set_defaults(fn=cmd_query_search)
+
+    an = sub.add_parser(
+        "analyse", help="storage health: codec/compression/zone-map/debt"
+    ).add_subparsers(dest="what", required=True)
+    ab = an.add_parser("block")
+    ab.add_argument("tenant")
+    ab.add_argument("block")
+    ab.add_argument("--json", action="store_true")
+    ab.set_defaults(fn=cmd_analyse_block)
+    abs_ = an.add_parser("blocks")
+    abs_.add_argument("tenant")
+    abs_.add_argument("--json", action="store_true")
+    abs_.add_argument("--window-s", type=int, default=3600,
+                      help="compaction window for the debt sweep")
+    abs_.set_defaults(fn=cmd_analyse_blocks)
 
     gen = sub.add_parser("gen", help="regenerate derived objects").add_subparsers(dest="what", required=True)
     gb = gen.add_parser("bloom")

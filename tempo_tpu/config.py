@@ -265,6 +265,11 @@ def check_config(cfg: Config) -> list[str]:
             f"self_tracing.sample_ratio ({app.self_tracing.sample_ratio}) is "
             "outside [0, 1]; values clamp to never/always"
         )
+    if 0 < app.db.analytics_scan_s < app.db.blocklist_poll_s:
+        warnings.append(
+            "storage.trace.analytics_scan_s is shorter than blocklist_poll_s: "
+            "scans between polls re-walk an unchanged blocklist for nothing"
+        )
     resident_cap = app.frontend.target_bytes_per_job * max(1, app.frontend.query_shards)
     if 0 < app.resource.inflight_query_bytes < 2 * resident_cap:
         warnings.append(

@@ -74,6 +74,12 @@ class DBConfig:
     # are deleted by sweep_orphans once they stay meta-less this long —
     # long enough that no healthy in-flight write is still mid-block
     orphan_grace_s: float = 900.0
+    # storage-health analytics (db/analytics.StorageScanner): period of
+    # the background pass exporting zone-map coverage / compaction-debt
+    # gauges and caching /status/storage. 0 disables the background
+    # scan (the endpoint then computes on demand). Runs on compaction-
+    # owning roles only — one fleet scanner per deployment is enough.
+    analytics_scan_s: float = 600.0
 
 
 class TempoDB:

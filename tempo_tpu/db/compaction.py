@@ -16,7 +16,7 @@ import time
 from dataclasses import dataclass, field
 
 from tempo_tpu.backend.base import BlockMeta, CompactedBlockMeta
-from tempo_tpu.util import metrics, tracing
+from tempo_tpu.util import metrics, tracing, usage
 
 log = logging.getLogger(__name__)
 
@@ -211,7 +211,12 @@ class CompactionDriver:
         with tracing.span("compactor/job", tenant=tenant,
                           inputs=len(group),
                           bytes=sum(m.size_bytes for m in group)):
-            return self._compact_blocks_traced(tenant, group)
+            # cost plane: this tenant's background maintenance (reads,
+            # decode, device sketch time) settles under kind=compaction
+            # — RESYSTANCE's lesson is that measuring where compaction
+            # work goes is what unlocks scheduling it well
+            with usage.attribute(tenant, "compaction"):
+                return self._compact_blocks_traced(tenant, group)
 
     def _compact_blocks_traced(self, tenant: str, group: list[BlockMeta]):
         enc = self.db.encoding_for(group[0].version)

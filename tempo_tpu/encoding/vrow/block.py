@@ -34,6 +34,8 @@ from tempo_tpu.encoding.vtpu import format as vfmt
 from tempo_tpu.model.columnar import SpanBatch
 from tempo_tpu.model.trace import Trace, batch_to_traces, combine_traces
 from tempo_tpu.ops import bloom, sketch
+from tempo_tpu.util import usage
+from tempo_tpu.encoding.vtpu.block import inspected_bytes_total
 
 
 class TraceQLUnsupported(NotImplementedError):
@@ -160,10 +162,15 @@ class VrowBackendBlock:
         self._index = None
         self.bytes_read = 0
 
+    def _account_inspected(self, nbytes: int) -> None:
+        usage.account_bytes(inspected_bytes_total, "inspected_bytes",
+                            self.meta.tenant_id, nbytes, round_trip=True)
+
     def index(self) -> rfmt.PageIndex:
         if self._index is None:
             raw = self.backend.read_named(self.meta.tenant_id, self.meta.block_id, ColumnIndexName)
             self.bytes_read += len(raw)
+            self._account_inspected(len(raw))
             self._index = rfmt.PageIndex.from_bytes(raw)
         return self._index
 
@@ -172,6 +179,8 @@ class VrowBackendBlock:
             self.meta.tenant_id, self.meta.block_id, DataName, entry.offset, entry.length
         )
         self.bytes_read += len(buf)
+        self._account_inspected(len(buf))
+        usage.charge("pages_fetched")
         return rfmt.decode_page(buf)
 
     def bloom_plan(self) -> bloom.BloomPlan:
@@ -187,6 +196,7 @@ class VrowBackendBlock:
         shard = int(bloom.shard_for_ids(limbs[None, :], p)[0])
         raw = self.backend.read_named(self.meta.tenant_id, self.meta.block_id, bloom_name(shard))
         self.bytes_read += len(raw)
+        self._account_inspected(len(raw))
         words = bloom.shard_from_bytes(raw)
         return bool(bloom.np_test_one_shard(words, limbs[None, :], p)[0])
 

@@ -55,7 +55,7 @@ from tempo_tpu.encoding.vtpu import format as fmt
 from tempo_tpu.model.columnar import ATTR_COLUMNS, SPAN_COLUMNS, VT_STR, SpanBatch
 from tempo_tpu.model.trace import Trace, batch_to_traces
 from tempo_tpu.ops import bloom
-from tempo_tpu.util import metrics, stagetimings
+from tempo_tpu.util import metrics, stagetimings, usage
 
 # columns needed to build TraceSearchMetadata for matching traces
 _META_COLS = ["trace_id", "parent_span_id", "start_unix_nano", "duration_nano", "name", "service"]
@@ -77,6 +77,16 @@ decoded_bytes_total = metrics.counter(
     "(run/dictionary-space reads count their encoded size; selective "
     "gathers count the rows/miniblocks touched)",
 )
+inspected_bytes_total = metrics.counter(
+    "tempodb_inspected_bytes_total",
+    "Bytes read from backend storage by block readers (index, "
+    "dictionary, bloom, coalesced page ranges), by tenant",
+)
+# tenant series of the read counters evict with the usage accountant's
+# idle-tenant GC (the readers touch() the accountant on every account),
+# so a tenant-ID fuzzing querier can't grow /metrics forever
+usage.register_tenant_family(inspected_bytes_total)
+usage.register_tenant_family(decoded_bytes_total)
 
 
 def runspace_enabled() -> bool:
@@ -342,6 +352,7 @@ class VtpuBackendBlock:
                 raw = self.backend.read_named(
                     self.meta.tenant_id, self.meta.block_id, ColumnIndexName)
             self.bytes_read += len(raw)
+            self._account_inspected(len(raw))
             self._index = fmt.BlockIndex.from_bytes(raw)
         return self._index
 
@@ -371,6 +382,7 @@ class VtpuBackendBlock:
                 raw = self.backend.read_named(
                     self.meta.tenant_id, self.meta.block_id, DictionaryName)
             self.bytes_read += len(raw)
+            self._account_inspected(len(raw))
             self._dict = fmt.deserialize_dictionary(raw)
         return self._dict
 
@@ -378,6 +390,7 @@ class VtpuBackendBlock:
         def read(offset, length):
             with self._io_lock:
                 self.bytes_read += length
+            self._account_inspected(length)
             # every page read lands in the waterfall's "fetch" bucket
             # (exclusive: the enclosing "decode" stage subtracts it)
             with stagetimings.stage("fetch"):
@@ -387,16 +400,25 @@ class VtpuBackendBlock:
 
         return read
 
+    def _account_inspected(self, nbytes: int) -> None:
+        """One backend read of nbytes (usage.account_bytes keeps the
+        untagged counter and the active request's cost vector moving
+        together, so per-tenant attribution always sums to the counter)."""
+        usage.account_bytes(inspected_bytes_total, "inspected_bytes",
+                            self.meta.tenant_id, nbytes, round_trip=True)
+
     def _account_decoded(self, nbytes: int) -> None:
         with self._io_lock:
             self.decoded_bytes += nbytes
-        decoded_bytes_total.inc(nbytes)
+        usage.account_bytes(decoded_bytes_total, "decoded_bytes",
+                            self.meta.tenant_id, nbytes)
 
     def _fetch_columns(self, rg: fmt.RowGroupMeta, names: list[str]) -> dict[str, np.ndarray]:
         """Fetch+decode columns with coalesced ranged reads, accounting
         the round trips saved vs one-read-per-page."""
         with stagetimings.stage("decode"):  # IO inside lands in "fetch"
             cols, n_reads, _ = fmt.read_columns_coalesced(self._reader(), rg, names)
+        usage.charge("pages_fetched", len(names))
         saved = len(names) - n_reads
         if saved > 0:
             with self._io_lock:
@@ -482,6 +504,7 @@ class VtpuBackendBlock:
         shard = int(bloom.shard_for_ids(limbs[None, :], p)[0])
         raw = self.backend.read_named(self.meta.tenant_id, self.meta.block_id, bloom_name(shard))
         self.bytes_read += len(raw)
+        self._account_inspected(len(raw))
         words = bloom.shard_from_bytes(raw)
         if not bloom.np_test_one_shard(words, limbs[None, :], p)[0]:
             return None

@@ -28,6 +28,8 @@ import os
 import threading
 from collections import OrderedDict
 
+from tempo_tpu.util import usage
+
 
 class ColumnCache:
     """Bytes-bounded, thread-safe LRU of numpy arrays.
@@ -65,9 +67,12 @@ class ColumnCache:
             if arr is not None:
                 self._lru.move_to_end(key)
                 self.hits += 1
-                return arr
-            self.misses += 1
-            return None
+            else:
+                self.misses += 1
+        # cost plane: hit/miss charged to the requesting tenant's vector
+        # (outside the lock — charge takes the vector's own lock)
+        usage.charge("cache_hits" if arr is not None else "cache_misses")
+        return arr
 
     def put(self, key, arr) -> None:
         try:

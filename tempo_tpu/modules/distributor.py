@@ -21,7 +21,7 @@ from tempo_tpu.encoding.vtpu import format as fmt
 from tempo_tpu.model.columnar import SpanBatch
 from tempo_tpu.model.trace import traces_to_batch
 from tempo_tpu.ops import hashing
-from tempo_tpu.util import metrics, resource, tracing
+from tempo_tpu.util import metrics, resource, tracing, usage
 
 log = logging.getLogger(__name__)
 
@@ -137,6 +137,14 @@ class Distributor:
                     self.metrics.traces_rate_limited,
                 ):
                     d.pop(t, None)
+        # tenant labels on the core cost counters are bounded by the
+        # same eviction: drop the idle tenants' label sets so /metrics
+        # cardinality tracks ACTIVE tenants, not every ID ever seen
+        for t in idle:
+            for c in (spans_received, bytes_received, discarded_spans):
+                c.drop_labels(tenant=t)
+        if idle:
+            usage.ACCOUNTANT.evict_idle_tenants()
         return len(idle)
 
     # ------------------------------------------------------------------
@@ -208,6 +216,10 @@ class Distributor:
         self.metrics.bytes_received[tenant] = self.metrics.bytes_received.get(tenant, 0) + size
         spans_received.inc(batch.num_spans, tenant=tenant)
         bytes_received.inc(size, tenant=tenant)
+        # cost plane: ingest settles HERE (the front door owns ingest
+        # attribution; replicas are capacity, not tenant demand)
+        usage.record(tenant, "ingest",
+                     ingested_bytes=size, ingested_spans=batch.num_spans)
 
         with tracing.span("distributor/group_by_replica", spans=batch.num_spans):
             groups = self._group_by_replica(tenant, batch)

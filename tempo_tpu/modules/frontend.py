@@ -24,7 +24,7 @@ from dataclasses import dataclass
 from tempo_tpu.encoding.common import SearchRequest, SearchResponse, TraceSearchMetadata
 from tempo_tpu.model.trace import combine_traces
 from tempo_tpu.modules.worker import JobBroker, decode_trace_result
-from tempo_tpu.util import metrics, resource, stagetimings, tracing
+from tempo_tpu.util import metrics, resource, stagetimings, tracing, usage
 
 log = logging.getLogger(__name__)
 
@@ -292,14 +292,18 @@ class Frontend:
 
     @staticmethod
     def _merge_stage_wires(results: list) -> None:
-        """Fold each worker's stage waterfall (riding the job result as
-        "stages") into this query's accumulator — the stage analog of
-        the search/metrics partial merges."""
+        """Fold each worker's stage waterfall ("stages") and cost vector
+        ("usage") riding the job results into this query's accumulators
+        — the same shard-wise partial merge the search/metrics responses
+        use. The merged cost vector settles under (tenant, kind) when
+        the request's usage.attribute scope exits."""
         acc = stagetimings.active()
-        if acc is None:
-            return
+        uv = usage.active()
         for r in results:
-            acc.merge_wire(r.get("stages"))
+            if acc is not None:
+                acc.merge_wire(r.get("stages"))
+            if uv is not None:
+                uv.merge_wire(r.get("usage"))
 
     def _settle(self, tenant: str, n_shards: int, results: list, errors: list) -> int:
         """Apply the failed-shard budget to a query's terminal errors.
@@ -376,7 +380,7 @@ class Frontend:
     def find_trace_by_id(self, tenant: str, trace_id: bytes):
         """Shard the blockID space + one ingester job; combine partials,
         dedupe spans (reference: newTraceByIDMiddleware frontend.go:97)."""
-        with stagetimings.request() as st:
+        with stagetimings.request() as st, usage.attribute(tenant, "find"):
             with tracing.span("frontend/find", tenant=tenant,
                               trace=trace_id.hex()):
                 out = self._find_traced(tenant, trace_id)
@@ -412,7 +416,7 @@ class Frontend:
     def search(self, tenant: str, req: SearchRequest) -> SearchResponse:
         """Ingester window job + one job per chunk of backend blocks
         (reference: searchsharding.go:266 backendRequests)."""
-        with stagetimings.request() as st:
+        with stagetimings.request() as st, usage.attribute(tenant, "search"):
             with tracing.span("frontend/search", tenant=tenant):
                 out = self._search_traced(tenant, req)
             wire = st.to_wire()
@@ -490,7 +494,7 @@ class Frontend:
         segments (the not-yet-flushed tail); block jobs cover flushed
         data, the same disjointness contract the search path uses.
         """
-        with stagetimings.request() as st:
+        with stagetimings.request() as st, usage.attribute(tenant, "query_range"):
             with tracing.span("frontend/query_range", tenant=tenant):
                 mat = self._query_range_traced(
                     tenant, query, start_s, end_s, step_s,
@@ -582,7 +586,7 @@ class Frontend:
     # ------------------------------------------------------------------
     def traceql(self, tenant: str, query: str, start_s=0, end_s=0, limit=20,
                 stats: dict | None = None):
-        with stagetimings.request() as st:
+        with stagetimings.request() as st, usage.attribute(tenant, "traceql"):
             with tracing.span("frontend/traceql", tenant=tenant, q=query):
                 out = self._traceql_traced(tenant, query, start_s, end_s,
                                            limit, stats)

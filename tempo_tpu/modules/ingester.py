@@ -23,7 +23,7 @@ import numpy as np
 from tempo_tpu.encoding.vtpu import format as fmt
 from tempo_tpu.model.columnar import SpanBatch
 from tempo_tpu.model.trace import Trace, batch_to_traces, combine_traces
-from tempo_tpu.util import metrics, resource, tracing
+from tempo_tpu.util import metrics, resource, tracing, usage
 from tempo_tpu.util.flushqueues import ExclusiveQueues, FlushOp
 
 log = logging.getLogger(__name__)
@@ -264,6 +264,8 @@ class TenantInstance:
         self._release_block_accounting(blk)
         if meta is not None:
             blocks_flushed.inc(tenant=self.tenant)
+            # cost plane: backend PUT bytes of this tenant's flush
+            usage.record(self.tenant, "ingest", flushed_bytes=meta.size_bytes)
         return meta
 
     def _release_block_accounting(self, blk) -> None:

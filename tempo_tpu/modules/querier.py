@@ -70,12 +70,22 @@ class Querier:
     def _live_batches(self, tenant: str):
         """All not-yet-flushed columnar segments across ingesters; a
         failing ingester is skipped, not fatal."""
+        from tempo_tpu.encoding.vtpu.block import inspected_bytes_total
+        from tempo_tpu.util import usage
+
         out = []
         for client in self.ingester_clients.values():
             try:
                 out.extend(client.live_batches(tenant))
             except Exception:
                 log.exception("ingester live_batches failed")
+        # live-tail scans are query cost like any block read: charge the
+        # scanned bytes to the requesting tenant (counter + vector move
+        # together, preserving the attribution-exactness invariant)
+        scanned = sum(b.nbytes() for b in out)
+        if scanned:
+            usage.account_bytes(inspected_bytes_total, "inspected_bytes",
+                                tenant, scanned)
         return out
 
     def search_recent(self, tenant: str, req: SearchRequest) -> SearchResponse:

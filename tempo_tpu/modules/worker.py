@@ -33,7 +33,7 @@ import weakref
 
 from tempo_tpu.encoding.common import SearchRequest, SearchResponse
 from tempo_tpu.modules.queue import RequestQueue
-from tempo_tpu.util import deadline, metrics, stagetimings, tracing
+from tempo_tpu.util import deadline, metrics, stagetimings, tracing, usage
 
 log = logging.getLogger(__name__)
 
@@ -71,7 +71,10 @@ def execute_job(querier, tenant: str, desc: dict) -> dict:
     time no stage claimed lands in "other", so the buckets sum to the
     job's wall clock instead of silently under-reporting."""
     with deadline.scope(desc.get("deadline")):
-        with stagetimings.request() as st:
+        # collect (never settle) the job's cost vector: it rides the
+        # result as "usage" and the FRONTEND settles the merged shards
+        # under (tenant, kind) — one owner per query, no double count
+        with stagetimings.request() as st, usage.collect() as uv:
             queue_wait = 0.0
             sub = desc.get("submitted_at")
             if sub:
@@ -88,6 +91,7 @@ def execute_job(querier, tenant: str, desc: dict) -> dict:
                 st.add("other", max(0.0, exec_dt - staged))
             if isinstance(out, dict):
                 out["stages"] = st.to_wire()
+                out["usage"] = uv.to_wire()
             return out
 
 

@@ -56,6 +56,7 @@ class Reporter:
         self.version = version
         self._edge: dict[str, float] = {}
         self._extra: dict = {}
+        self._providers: list = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = None
@@ -67,6 +68,14 @@ class Reporter:
         with self._lock:
             self._extra[name] = value
 
+    def register_provider(self, fn) -> None:
+        """fn() -> dict of stats merged into every report at build time
+        (the app registers storage-scale facts this way). Providers must
+        return feature/scale data ONLY — never tenant names; a raising
+        provider is skipped, never fatal (stats must not break the app)."""
+        with self._lock:
+            self._providers.append(fn)
+
     def build_report(self, now: float | None = None) -> dict:
         if self.seed is None:
             self.seed = get_or_create_cluster_seed(self.raw)
@@ -75,6 +84,12 @@ class Reporter:
         now = now or time.time()
         with self._lock:
             extra = dict(self._extra)
+            providers = list(self._providers)
+        for fn in providers:
+            try:
+                extra.update(fn() or {})
+            except Exception as e:  # noqa: BLE001 — see register_provider
+                log.debug("usage-stats provider failed: %s", e)
         return {
             "clusterID": self.seed["UID"],
             "createdAt": self.seed["created_at"],

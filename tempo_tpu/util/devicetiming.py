@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import time
 
-from tempo_tpu.util import metrics, stagetimings
+from tempo_tpu.util import metrics, stagetimings, usage
 
 dispatch_hist = metrics.histogram(
     "tempo_tpu_device_dispatch_seconds",
@@ -59,3 +59,7 @@ def timed_dispatch(kernel: str, fn, *args, **kwargs):
         dispatch_total.inc(kernel=kernel)
         stagetimings.add("kernel", dt)
         stagetimings.count_dispatch()
+        # cost plane: device time is charged to whoever this dispatch
+        # serves (the worker's job vector, or compaction's)
+        usage.charge("device_seconds", dt)
+        usage.charge("device_dispatches")

@@ -39,7 +39,31 @@ def _label_key(labels: dict | None) -> tuple:
     return tuple(sorted((labels or {}).items()))
 
 
-class Counter:
+class _DropLabelsMixin:
+    """Remove label sets matching every given pair — the cardinality-
+    eviction seam: per-tenant series of churned/idle tenants are dropped
+    from the exposition (counters restart from 0 if the tenant returns;
+    rate() tolerates resets, unbounded label growth has no remedy)."""
+
+    def drop_labels(self, **match) -> int:
+        pairs = set(match.items())
+        with self._lock:
+            victims = [k for k in self._values if pairs.issubset(set(k))]
+            for k in victims:
+                del self._values[k]
+        return len(victims)
+
+    def total(self, **match) -> float:
+        """Sum across label sets (optionally only those containing every
+        given pair) — 'the untagged total' of a labelled family."""
+        pairs = set(match.items())
+        with self._lock:
+            return float(sum(
+                v for k, v in self._values.items() if pairs.issubset(set(k))
+            ))
+
+
+class Counter(_DropLabelsMixin):
     def __init__(self, name: str, help_: str = ""):
         self.name = name
         self.help = help_
@@ -65,7 +89,7 @@ class Counter:
         return out
 
 
-class Gauge:
+class Gauge(_DropLabelsMixin):
     def __init__(self, name: str, help_: str = ""):
         self.name = name
         self.help = help_

@@ -16,6 +16,8 @@ import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
 
+from tempo_tpu.util import usage
+
 _SENTINEL = object()
 
 
@@ -150,6 +152,11 @@ class ReadAhead:
 
     def __init__(self, load, n_items: int):
         self._load = load
+        # the prefetch thread loads bytes FOR the request that created
+        # this ReadAhead: carry its cost vector (and only that — stage
+        # timings stay per-thread so overlapped IO never double-counts
+        # wall-clock buckets) into the background loads
+        self._usage_vec = usage.active()
         self._n = n_items
         self._next = 0
         self._future = None
@@ -199,7 +206,8 @@ class ReadAhead:
     def _schedule(self):
         if self._pool is not None and self._next < self._n:
             i = self._next
-            self._future = self._pool.submit(self._load, i)
+            self._future = self._pool.submit(
+                usage.run_with, self._usage_vec, self._load, i)
 
     def get(self, i: int):
         """Items must be requested in order 0..n-1."""

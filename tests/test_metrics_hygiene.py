@@ -12,6 +12,10 @@ conventions in review); here the rules are executable so a PR that adds
 - label names match the Prometheus data model
 - sample lines belong to a declared family (histograms may emit
   _bucket/_sum/_count; counters emit their own name)
+- no family exceeds its declared series-cardinality budget (the
+  per-tenant labels ISSUE 10 added must never explode /metrics —
+  idle-tenant eviction keeps tenant series bounded, this guard keeps
+  everyone honest about it)
 """
 
 import re
@@ -119,6 +123,118 @@ def test_label_names_valid(exposition):
             if not LABEL_RE.fullmatch(lname) or lname.startswith("__"):
                 bad.append((name, lname))
     assert not bad, f"invalid label names: {bad}"
+
+
+# -- series-cardinality budgets ------------------------------------------
+#
+# Budget = max label sets (series) one family may expose, `le` excluded
+# (histogram buckets are geometry, not cardinality). The default covers
+# label-less and small-enum families; anything labelled by tenant/route/
+# kernel must DECLARE its budget here — adding an unbounded label without
+# declaring (and defending) a budget is exactly the regression this
+# guard exists to catch. Budgets assume bounded-tenant deployments with
+# idle-tenant eviction armed (distributor + usage accountant + scanner).
+DEFAULT_SERIES_BUDGET = 24
+FAMILY_SERIES_BUDGETS = {
+    # method x route x status on the HTTP server
+    "tempo_request_duration_seconds_total": 600,
+    "tempo_request_duration_seconds": 200,
+    # stage x kind waterfall
+    "tempo_tpu_query_stage_seconds": 64,
+    "tempo_tpu_query_device_dispatches_total": 8,
+    # kernel-labelled device timing
+    "tempo_tpu_device_dispatch_seconds": 32,
+    "tempo_tpu_device_dispatches_total": 32,
+    # component x reason sheds
+    "tempo_tpu_shed_total": 32,
+    # tenant-labelled families (eviction-bounded: ~T active tenants,
+    # x reason / kind / codec where applicable)
+    "tempo_distributor_spans_received_total": 64,
+    "tempo_distributor_bytes_received_total": 64,
+    "tempo_discarded_spans_total": 192,
+    "tempo_ingester_blocks_flushed_total": 64,
+    "tempo_ingester_blocks_dropped_total": 64,
+    "tempo_ingester_live_traces": 64,
+    "tempo_ingester_pressure_cuts_total": 64,
+    "tempo_ingester_pushes_refused_total": 64,
+    "tempodb_blocklist_length": 64,
+    "tempodb_inspected_bytes_total": 64,
+    "tempodb_decoded_bytes_total": 64,
+    "tempodb_compaction_runs_total": 64,
+    "tempodb_compaction_errors_total": 64,
+    "tempodb_compaction_blocks_compacted_total": 64,
+    "tempodb_compaction_objects_written_total": 64,
+    "tempodb_compaction_slow_jobs_total": 64,
+    "tempodb_compaction_pages_copied_verbatim_total": 64,
+    "tempodb_compaction_pages_reencoded_total": 64,
+    "tempodb_orphan_blocks_swept_total": 64,
+    "tempodb_blocklist_quarantined_blocks": 64,
+    "tempodb_zonemap_coverage_ratio": 64,
+    "tempodb_compaction_debt_row_groups": 64,
+    "tempodb_compaction_debt_ratio": 64,
+    "tempodb_compaction_debt_payoff": 64,
+    "tempodb_storage_compression_ratio": 64,
+    "tempodb_storage_codec_stored_bytes": 16,  # codec enum
+    # tenant x kind cost counters (usage accountant eviction bounds tenant)
+    **{f"tempo_tpu_usage_{f}_total": 448 for f in (
+        "ingested_bytes", "ingested_spans", "flushed_bytes",
+        "inspected_bytes", "decoded_bytes", "pages_fetched",
+        "ranged_reads", "cache_hits", "cache_misses",
+        "device_seconds", "device_dispatches")},
+}
+
+
+def _series_per_family(text):
+    _, types, samples = _parse(text)
+    fam_of = {}
+    for name, kind in types:
+        fam_of[name] = name
+        if kind == "histogram":
+            for sfx in ("_bucket", "_sum", "_count"):
+                fam_of[name + sfx] = name
+    series: dict[str, set] = {}
+    for name, labelstr in samples:
+        fam = fam_of.get(name)
+        if fam is None:
+            continue
+        labels = tuple(sorted(
+            (k, v) for k, v in LABEL_PAIR_RE.findall(labelstr or "")
+            if k != "le"
+        ))
+        series.setdefault(fam, set()).add(labels)
+    return series
+
+
+def test_series_cardinality_within_budget(exposition):
+    """Every family fits its declared label-cardinality budget. A family
+    growing past the default must declare (and justify) a budget above —
+    'I added a label' is not a license for unbounded series."""
+    series = _series_per_family(exposition)
+    over = {
+        fam: (len(s), FAMILY_SERIES_BUDGETS.get(fam, DEFAULT_SERIES_BUDGET))
+        for fam, s in series.items()
+        if len(s) > FAMILY_SERIES_BUDGETS.get(fam, DEFAULT_SERIES_BUDGET)
+    }
+    assert not over, (
+        f"families over their series budget (series, budget): {over} — "
+        "either the label set is unbounded (fix the code: eviction / "
+        "enum labels only) or the budget must be raised HERE with a "
+        "justification"
+    )
+
+
+def test_budgeted_families_exist_or_are_future(exposition):
+    """Typo guard: every explicitly budgeted family must be a registered
+    metric (budgets for dead names rot silently). Requests the booted-app
+    fixture so the registry's import set is deterministic even when this
+    test runs alone."""
+    del exposition  # only needed for its boot side effect
+    from tempo_tpu.util.metrics import REGISTRY
+
+    with REGISTRY._lock:
+        known = set(REGISTRY._metrics)
+    dead = [f for f in FAMILY_SERIES_BUDGETS if f not in known]
+    assert not dead, f"budgets declared for unregistered families: {dead}"
 
 
 def test_registry_wide_help_nonempty():

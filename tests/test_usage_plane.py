@@ -1,0 +1,369 @@
+"""Cost-attribution & storage-health plane (ISSUE 10).
+
+Two contracts under test:
+
+1. **Attribution exactness** — on a multi-tenant e2e drive, the
+   per-tenant cost vectors (util/usage) sum EXACTLY to the untagged
+   process counters (ingest bytes/spans at the distributor, inspected/
+   decoded bytes at the block readers, device dispatches), tenants see
+   only their own usage through /api/usage, and the endpoint reports
+   the same numbers the tempo_tpu_usage_*_total counters hold. Charges
+   ride the same statements as the counters, so equality is exact, not
+   approximate.
+
+2. **Compaction-debt ground truth** — the storage scanner's debt metric
+   agrees with plan_disjoint_runs verdicts on constructed overlapping/
+   disjoint block fixtures, and pays off to zero after compaction runs.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tempo_tpu.api.server import TempoServer
+from tempo_tpu.app import App, AppConfig
+from tempo_tpu.db import DBConfig, TempoDB
+from tempo_tpu.db import analytics
+from tempo_tpu.encoding.common import SearchRequest
+from tempo_tpu.model import synth
+from tempo_tpu.modules.distributor import bytes_received, spans_received
+from tempo_tpu.modules.frontend import FrontendConfig
+from tempo_tpu.util import usage
+from tempo_tpu.util.devicetiming import dispatch_total
+from tempo_tpu.encoding.vtpu.block import decoded_bytes_total, inspected_bytes_total
+
+TENANTS = ("acme", "globex")
+
+
+def _get(url, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+@pytest.fixture(scope="module")
+def driven(tmp_path_factory):
+    """Multi-tenant single-binary drive: ingest -> flush -> one of every
+    query kind per tenant, with counter deltas snapshotted around it.
+    Hedging/retries are disabled: a losing hedge's work is real cost the
+    response path discards, so exactness is only defined without it."""
+    tmp = tmp_path_factory.mktemp("usage_plane")
+    app = App(AppConfig(
+        multitenancy_enabled=True,
+        db=DBConfig(backend="local", backend_path=str(tmp / "blocks"),
+                    wal_path=str(tmp / "wal")),
+        frontend=FrontendConfig(hedge_after_s=0, max_retries=0),
+        generator_enabled=False,
+    ))
+    server = TempoServer(app).start()
+    usage.ACCOUNTANT.reset()
+    before = {
+        "ingested_bytes": bytes_received.total(),
+        "ingested_spans": spans_received.total(),
+        "inspected_bytes": inspected_bytes_total.total(),
+        "decoded_bytes": decoded_bytes_total.total(),
+        "device_dispatches": dispatch_total.total(),
+    }
+
+    pushed = {}
+    for i, tenant in enumerate(TENANTS):
+        traces = synth.make_traces(30, seed=100 + i, spans_per_trace=4)
+        for t in traces:
+            app.push_traces([t], org_id=tenant)
+        pushed[tenant] = traces
+    app.sweep_all(immediate=True)
+    app.db.poll_now()
+
+    responses = {}
+    for tenant in TENANTS:
+        r = {}
+        r["search"] = app.search(
+            SearchRequest(tags={"service": "cart"}, limit=1000), org_id=tenant)
+        r["traceql"] = app.traceql(
+            '{ resource.service.name = "cart" }', org_id=tenant, limit=1000)
+        r["query_range"] = app.query_range(
+            "{} | rate() by (resource.service.name)",
+            1_699_999_000, 1_700_001_000, 60, org_id=tenant)
+        r["find"] = app.find_trace(pushed[tenant][0].trace_id, org_id=tenant)
+        responses[tenant] = r
+
+    after = {
+        "ingested_bytes": bytes_received.total(),
+        "ingested_spans": spans_received.total(),
+        "inspected_bytes": inspected_bytes_total.total(),
+        "decoded_bytes": decoded_bytes_total.total(),
+        "device_dispatches": dispatch_total.total(),
+    }
+    deltas = {k: after[k] - before[k] for k in before}
+    yield app, server, responses, deltas
+    server.stop()
+    app.shutdown()
+
+
+def _attributed(field: str) -> float:
+    """Sum of `field` across every tenant and kind in the accountant."""
+    total = 0.0
+    for kinds in usage.ACCOUNTANT.snapshot().values():
+        for fields in kinds.values():
+            total += fields.get(field, 0.0)
+    return total
+
+
+class TestAttributionExactness:
+    def test_ingest_sums_to_untagged_totals(self, driven):
+        _app, _srv, _resp, deltas = driven
+        assert _attributed("ingested_bytes") == pytest.approx(
+            deltas["ingested_bytes"], abs=1e-6)
+        assert _attributed("ingested_spans") == pytest.approx(
+            deltas["ingested_spans"], abs=1e-6)
+        for tenant in TENANTS:
+            row = usage.ACCOUNTANT.snapshot(tenant)[tenant]
+            assert row["ingest"]["ingested_bytes"] > 0
+            assert row["ingest"]["ingested_spans"] == 30 * 4
+
+    def test_read_costs_sum_to_untagged_totals(self, driven):
+        """inspected/decoded per-tenant vectors == the process counters,
+        bit-exact: attribution splits the measurement, never re-measures."""
+        _app, _srv, _resp, deltas = driven
+        assert _attributed("inspected_bytes") == pytest.approx(
+            deltas["inspected_bytes"], abs=1e-6)
+        assert _attributed("decoded_bytes") == pytest.approx(
+            deltas["decoded_bytes"], abs=1e-6)
+        # and the queries actually read bytes (the equality is not 0 == 0)
+        assert deltas["inspected_bytes"] > 0
+        assert deltas["decoded_bytes"] > 0
+
+    def test_device_dispatches_sum_to_untagged_totals(self, driven):
+        _app, _srv, _resp, deltas = driven
+        assert _attributed("device_dispatches") == pytest.approx(
+            deltas["device_dispatches"], abs=1e-6)
+
+    def test_per_tenant_counters_match_accountant(self, driven):
+        """The tempo_tpu_usage_*_total{tenant,kind} series hold the same
+        numbers /api/usage reports — one source of truth, two views."""
+        from tempo_tpu.util.usage import _counters
+
+        for tenant in TENANTS:
+            snap = usage.ACCOUNTANT.snapshot(tenant)[tenant]
+            for kind, fields in snap.items():
+                for field, v in fields.items():
+                    assert _counters[field].value(
+                        tenant=tenant, kind=kind) == pytest.approx(v)
+
+    def test_api_usage_is_tenant_scoped(self, driven):
+        """Tenants see ONLY their own usage; the operator's /status/usage
+        sees everyone."""
+        _app, server, _resp, _d = driven
+        status, doc = _get(server.url + "/api/usage",
+                           headers={"X-Scope-OrgID": "acme"})
+        assert status == 200
+        assert doc["tenant"] == "acme"
+        assert doc["kinds"]["ingest"]["ingested_bytes"] > 0
+        assert doc["kinds"]["search"]["inspected_bytes"] > 0
+        # nothing of globex leaks into acme's view
+        assert "globex" not in json.dumps(doc)
+        acct = usage.ACCOUNTANT.snapshot("acme")["acme"]
+        assert doc["kinds"] == json.loads(json.dumps(acct))  # same numbers
+
+        status, admin = _get(server.url + "/status/usage")
+        assert status == 200
+        assert set(TENANTS) <= set(admin["tenants"])
+        assert admin["tenants"]["acme"]["kinds"] == doc["kinds"]
+
+    def test_every_query_kind_attributed(self, driven):
+        _app, _srv, _resp, _d = driven
+        for tenant in TENANTS:
+            kinds = usage.ACCOUNTANT.snapshot(tenant)[tenant]
+            for kind in ("search", "traceql", "query_range", "find"):
+                assert kind in kinds, f"{tenant} missing {kind}"
+                assert kinds[kind].get("inspected_bytes", 0) > 0, (tenant, kind)
+
+
+class TestCardinalityEviction:
+    def test_idle_tenant_rows_and_label_sets_evicted(self):
+        from tempo_tpu.util.usage import _counters
+
+        usage.record("ghost-tenant", "search", inspected_bytes=123)
+        assert "ghost-tenant" in usage.ACCOUNTANT.snapshot()
+        assert _counters["inspected_bytes"].value(
+            tenant="ghost-tenant", kind="search") == 123
+        evicted = usage.ACCOUNTANT.evict_idle_tenants(older_than_s=0)
+        assert evicted >= 1
+        assert "ghost-tenant" not in usage.ACCOUNTANT.snapshot()
+        assert _counters["inspected_bytes"].value(
+            tenant="ghost-tenant", kind="search") == 0
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError):
+            usage.record("t", "totally-custom-kind", inspected_bytes=1)
+
+
+# ---------------------------------------------------------------------------
+# storage health / compaction debt
+# ---------------------------------------------------------------------------
+
+
+def _batch_in_half(n_traces: int, seed: int, upper: bool):
+    """A trace-sorted batch whose trace IDs live entirely in the lower
+    or upper half of the 128-bit ID space — disjoint by construction."""
+    b = synth.make_batch(n_traces, 4, seed=seed)
+    tid = b.cols["trace_id"].copy()
+    tid[:, 0] = (tid[:, 0] & np.uint32(0x7FFFFFFF)) | np.uint32(
+        0x80000000 if upper else 0)
+    b.cols["trace_id"] = tid
+    return b.sorted_by_trace()
+
+
+@pytest.fixture()
+def debt_db(tmp_path):
+    db = TempoDB(DBConfig(backend="local", backend_path=str(tmp_path / "blocks"),
+                          wal_path=str(tmp_path / "wal")))
+    # overlap tenant: the same ID range written twice -> every row group
+    # overlaps its twin -> 100% debt
+    dup = synth.make_batch(300, 4, seed=7)
+    db.write_batch("overlap", dup)
+    db.write_batch("overlap", synth.make_batch(300, 4, seed=7))
+    # disjoint tenant: two blocks in opposite halves of the ID space ->
+    # zero overlap -> zero debt
+    db.write_batch("disjoint", _batch_in_half(300, seed=8, upper=False))
+    db.write_batch("disjoint", _batch_in_half(300, seed=9, upper=True))
+    db.poll_now()
+    return db
+
+
+class TestCompactionDebt:
+    def _ground_truth(self, db, tenant):
+        """Debt computed straight from plan_disjoint_runs over the
+        blocks' row-group ranges — the number the scanner must match."""
+        from tempo_tpu.parallel.compaction import plan_disjoint_runs
+
+        ranges = []
+        for m in db.blocklist.metas(tenant):
+            blk = db.encoding_for(m.version).open_block(m, db.backend, db.cfg.block)
+            ranges.append([(rg.min_id, rg.max_id) for rg in blk.index().row_groups])
+        merge = relocate = 0
+        for seg in plan_disjoint_runs(ranges):
+            if seg[0] == "merge":
+                merge += sum(hi - lo for lo, hi in seg[1].values())
+            else:
+                relocate += 1
+        return merge, relocate
+
+    def test_debt_matches_plan_disjoint_runs(self, debt_db):
+        for tenant, expect_debt in (("overlap", True), ("disjoint", False)):
+            truth_merge, truth_reloc = self._ground_truth(debt_db, tenant)
+            report = analytics.analyse_tenant(debt_db, tenant)
+            debt = report["compactionDebt"]
+            assert debt["mergeRowGroups"] == truth_merge
+            assert debt["relocateRowGroups"] == truth_reloc
+            assert debt["totalRowGroups"] == truth_merge + truth_reloc
+            if expect_debt:
+                assert truth_merge > 0 and debt["debtRatio"] == 1.0
+                assert debt["payoff"] > 0  # zone maps present -> payoff
+            else:
+                assert truth_merge == 0 and debt["debtRatio"] == 0.0
+
+    def test_scanner_gauges_match_ground_truth(self, debt_db):
+        scanner = analytics.StorageScanner(debt_db, interval_s=3600)
+        scanner.scan_once()
+        truth_merge, _ = self._ground_truth(debt_db, "overlap")
+        assert analytics.debt_row_groups_gauge.value(tenant="overlap") == truth_merge
+        assert analytics.debt_ratio_gauge.value(tenant="overlap") == 1.0
+        assert analytics.debt_row_groups_gauge.value(tenant="disjoint") == 0
+        assert analytics.debt_ratio_gauge.value(tenant="disjoint") == 0.0
+        # freshly written blocks carry zone maps end to end
+        assert analytics.zonemap_coverage_gauge.value(tenant="overlap") == 1.0
+
+    def test_debt_pays_off_after_compaction(self, debt_db):
+        while debt_db.compact_once("overlap"):
+            debt_db.poll_now()
+        report = analytics.analyse_tenant(debt_db, "overlap")
+        assert report["compactionDebt"]["mergeRowGroups"] == 0
+        assert report["compactionDebt"]["debtRatio"] == 0.0
+        # compaction itself was attributed to the tenant
+        snap = usage.ACCOUNTANT.snapshot("overlap").get("overlap", {})
+        assert snap.get("compaction", {}).get("inspected_bytes", 0) > 0
+
+    def test_analyse_block_economics(self, debt_db):
+        m = debt_db.blocklist.metas("overlap")[0]
+        a = analytics.analyse_block(debt_db, m)
+        assert a["supported"] and a["rowGroups"] >= 1
+        # stored never exceeds raw on synthetic data; every page has a codec
+        assert 0 < a["compressionRatio"] <= 1.0
+        assert sum(a["codecPages"].values()) == sum(
+            c["pages"] for c in a["columns"].values())
+        assert a["zonemap"]["coverageRatio"] == 1.0
+        # lightweight codecs are in play (the PageMeta mix the analyser
+        # reports is what /status/storage and BENCH_r06+ consume)
+        assert set(a["codecPages"]) & {"rle", "dct", "dbp"}
+
+
+class TestStorageEndpointAndCLI:
+    def test_status_storage_endpoint(self, driven):
+        app, server, _resp, _d = driven
+        status, doc = _get(server.url + "/status/storage")
+        assert status == 200
+        assert set(TENANTS) <= set(doc["tenants"])
+        fleet = doc["fleet"]
+        assert fleet["blocks"] >= 2 and fleet["totalBytes"] > 0
+        assert 0 < fleet["compressionRatio"] <= 1.0
+        assert "zonemapCoverageRatio" in fleet
+        for t in TENANTS:
+            assert "compactionDebt" in doc["tenants"][t]
+        # no tenant names in the fleet aggregate (usage-stats reuses it)
+        assert not any(t in json.dumps(fleet) for t in TENANTS)
+
+    def test_cli_analyse_block_and_blocks(self, debt_db, tmp_path, capsys):
+        from tempo_tpu.cli import main as cli_main
+
+        path = str(tmp_path / "blocks")  # debt_db's backend root
+        m = debt_db.blocklist.metas("overlap")[0]
+        assert cli_main(["--path", path, "analyse", "block", "overlap",
+                         str(m.block_id), "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["supported"] and doc["compressionRatio"] > 0
+        assert cli_main(["--path", path, "analyse", "blocks", "overlap",
+                         "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["compactionDebt"]["debtRatio"] == 1.0
+        # human-readable form renders too
+        assert cli_main(["--path", path, "analyse", "blocks", "overlap"]) == 0
+        out = capsys.readouterr().out
+        assert "compaction debt" in out and "zone-map coverage" in out
+
+
+class TestUsageStatsSnapshot:
+    def test_storage_scale_stats_in_report(self, tmp_path):
+        """The 4h anonymous snapshot carries storage-scale facts
+        (feature/scale only, never tenant names)."""
+        from tempo_tpu.usagestats import UsageStatsConfig
+
+        app = App(AppConfig(
+            multitenancy_enabled=True,
+            db=DBConfig(backend="local", backend_path=str(tmp_path / "blocks"),
+                        wal_path=str(tmp_path / "wal")),
+            generator_enabled=False,
+            usage_stats=UsageStatsConfig(enabled=True, endpoint="http://sink.invalid"),
+        ))
+        try:
+            app.push_traces(synth.make_traces(10, seed=3, spans_per_trace=3),
+                            org_id="secret-tenant-name")
+            app.sweep_all(immediate=True)
+            app.db.poll_now()
+            assert app.storage_scanner is not None
+            app.storage_scanner.scan_once()
+            report = app.usage_reporter.build_report()
+            m = report["metrics"]
+            assert m["storage_blocks"] >= 1
+            assert m["storage_total_bytes"] > 0
+            assert 0 < m["storage_compression_ratio"] <= 1.0
+            assert "storage_zonemap_coverage_ratio" in m
+            assert "storage_compaction_debt_row_groups" in m
+            assert any(k.startswith("storage_codec_pages_") for k in m)
+            assert "secret-tenant-name" not in json.dumps(report)
+        finally:
+            app.shutdown()

@@ -39,6 +39,28 @@ def _db(tmp, **kw):
     return TempoDB(DBConfig(backend="local", backend_path=tmp, **kw))
 
 
+def _storage_summary(db) -> dict:
+    """Storage-health numbers for the JSON line (BENCH_r06+ tracks
+    compression/debt/zone-map coverage beside the perf numbers)."""
+    from tempo_tpu.db.analytics import StorageScanner
+
+    fleet = StorageScanner(db).scan_once()["fleet"]
+    return {
+        "compression_ratio": fleet["compressionRatio"],
+        "zonemap_coverage": fleet["zonemapCoverageRatio"],
+        "debt_row_groups": fleet["compactionDebtRowGroups"],
+        "debt_payoff": fleet["compactionDebtPayoff"],
+        "codec_pages": fleet["codecPages"],
+    }
+
+
+def _cost_rollup() -> dict:
+    """Per-tenant cost vectors accumulated during this config's run."""
+    from tempo_tpu.util import usage
+
+    return usage.ACCOUNTANT.snapshot()
+
+
 def bench_ingest(n_spans: int = 10_000) -> dict:
     """Config 1: 10k spans through ingester cut/complete/flush + compaction."""
     from tempo_tpu.modules.ingester import Ingester, IngesterConfig
@@ -99,6 +121,7 @@ def bench_sweep(n_blocks: int = 100, traces_per_block: int = 200) -> dict:
             db.write_batch("bench", batch)
         db.poll_now()
 
+        storage_before = _storage_summary(db)
         t0 = time.perf_counter()
         cycles = jobs = 0
         while True:
@@ -120,6 +143,9 @@ def bench_sweep(n_blocks: int = 100, traces_per_block: int = 200) -> dict:
             "seconds": round(dt, 3),
             "blocks_per_s": round(m.blocks_in / dt, 3),
             "remaining_blocks": remaining,
+            # the sweep's whole point, measured: overlap debt paid down
+            "storage_before": storage_before,
+            "storage_after": _storage_summary(db),
         }
 
 
@@ -143,21 +169,27 @@ def bench_search(n_tenants: int = 3, blocks_per_tenant: int = 6,
                     sample_ids[tenant] = np.unique(batch.cols["trace_id"], axis=0)[:20]
         db.poll_now()
 
+        from tempo_tpu.util import usage
+
+        usage.ACCOUNTANT.reset()
         t0 = time.perf_counter()
         hits = 0
         for ti in range(n_tenants):
-            resp = db.search(f"tenant-{ti}", SearchRequest(tags={"service": "cart"}, limit=50))
+            tenant = f"tenant-{ti}"
+            with usage.attribute(tenant, "search"):
+                resp = db.search(tenant, SearchRequest(tags={"service": "cart"}, limit=50))
             hits += len(resp.traces)
         t_search = time.perf_counter() - t0
 
         t0 = time.perf_counter()
         found = tried = 0
         for tenant, ids in sample_ids.items():
-            for limbs in ids:
-                tid = np.asarray(limbs, dtype=">u4").tobytes()
-                tried += 1
-                if db.find(tenant, tid) is not None:
-                    found += 1
+            with usage.attribute(tenant, "find"):
+                for limbs in ids:
+                    tid = np.asarray(limbs, dtype=">u4").tobytes()
+                    tried += 1
+                    if db.find(tenant, tid) is not None:
+                        found += 1
         t_find = time.perf_counter() - t0
 
         return {
@@ -169,6 +201,10 @@ def bench_search(n_tenants: int = 3, blocks_per_tenant: int = 6,
             "search_hits": hits,
             "find_s": round(t_find, 3),
             "find_recall": found / max(tried, 1),
+            # rollup captured BEFORE the storage scan: the scan's
+            # kind=analytics charges must not pollute the bench cost
+            "tenant_cost": _cost_rollup(),
+            "storage": _storage_summary(db),
         }
 
 
@@ -194,6 +230,9 @@ def bench_metrics(n_tenants: int = 2, blocks_per_tenant: int = 4,
                 db.write_batch(f"tenant-{ti}", batch)
         db.poll_now()
 
+        from tempo_tpu.util import usage
+
+        usage.ACCOUNTANT.reset()
         queries = {
             "rate": "{} | rate() by (resource.service.name)",
             "quantile": "{} | quantile_over_time(duration, 0.5, 0.99)",
@@ -208,15 +247,18 @@ def bench_metrics(n_tenants: int = 2, blocks_per_tenant: int = 4,
                 tenant = f"tenant-{ti}"
                 plan = compile_metrics_plan(q, start, end, step)
                 acc = make_accumulator(plan, device=False)
-                for m in db.blocklist.metas(tenant):
-                    blk = db.encoding_for(m.version).open_block(m, db.backend, db.cfg.block)
-                    evaluate_block(plan, blk, acc)
-                    acc.stats["inspectedBytes"] += blk.bytes_read
+                with usage.attribute(tenant, "query_range"):
+                    for m in db.blocklist.metas(tenant):
+                        blk = db.encoding_for(m.version).open_block(m, db.backend, db.cfg.block)
+                        evaluate_block(plan, blk, acc)
+                        acc.stats["inspectedBytes"] += blk.bytes_read
                 series += len(acc.series.slots)
                 inspected += acc.stats["inspectedBytes"]
             out[f"{qname}_s"] = round(time.perf_counter() - t0, 3)
             out[f"{qname}_series"] = series
             out[f"{qname}_inspected_bytes"] = inspected
+        out["tenant_cost"] = _cost_rollup()  # before the scan's analytics charges
+        out["storage"] = _storage_summary(db)
         return out
 
 

@@ -53,10 +53,12 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _cfg(tmp, target, port, instance, kv_url, grpc_port=0, extra=""):
+def _cfg(tmp, target, port, instance, kv_url, grpc_port=0, extra="",
+         multitenant=False):
     grpc = f"\n  grpc_listen_port: {grpc_port}" if grpc_port else ""
+    mt = "multitenancy_enabled: true\n" if multitenant else ""
     return f"""
-target: {target}
+{mt}target: {target}
 server:
   http_listen_address: 127.0.0.1
   http_listen_port: {port}{grpc}
@@ -74,6 +76,7 @@ ring_heartbeat_timeout_s: 10
 ingester:
   max_trace_idle_s: 1.0
   flush_check_period_s: 1.0
+  max_block_duration_s: 5.0
 metrics_generator:
   enabled: false
 {extra}
@@ -81,13 +84,15 @@ metrics_generator:
 
 
 class Proc:
-    def __init__(self, tmp, target, name, kv_url, grpc_port=0, extra=""):
+    def __init__(self, tmp, target, name, kv_url, grpc_port=0, extra="",
+                 multitenant=False):
         self.name = name
         self.port = _free_port()
         self.url = f"http://127.0.0.1:{self.port}"
         cfg_path = f"{tmp}/{name}.yaml"
         with open(cfg_path, "w") as f:
-            f.write(_cfg(tmp, target, self.port, name, kv_url, grpc_port, extra))
+            f.write(_cfg(tmp, target, self.port, name, kv_url, grpc_port, extra,
+                         multitenant=multitenant))
         self.log = open(f"{tmp}/{name}.log", "w")
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         self.proc = subprocess.Popen(
@@ -119,22 +124,26 @@ class Proc:
         self.log.close()
 
 
-def start_cluster(tmp: str, grpc_port: int = 0) -> tuple[list[Proc], Proc, Proc]:
+def start_cluster(tmp: str, grpc_port: int = 0,
+                  multitenant: bool = False) -> tuple[list[Proc], Proc, Proc]:
     """-> (all procs, frontend/query entry, distributor entry).
 
     The frontend hosts the ring KV service ("local") and every other
     role joins through it — the same bootstrap the multi-process e2e
     test uses."""
-    front = Proc(tmp, "query-frontend", "front", kv_url="local")
+    front = Proc(tmp, "query-frontend", "front", kv_url="local",
+                 multitenant=multitenant)
     front.wait_ready()
     kv_url = front.url
     procs = [front]
-    procs.append(Proc(tmp, "ingester", "ing-a", kv_url))
-    procs.append(Proc(tmp, "ingester", "ing-b", kv_url))
-    dist = Proc(tmp, "distributor", "dist", kv_url, grpc_port=grpc_port)
+    procs.append(Proc(tmp, "ingester", "ing-a", kv_url, multitenant=multitenant))
+    procs.append(Proc(tmp, "ingester", "ing-b", kv_url, multitenant=multitenant))
+    dist = Proc(tmp, "distributor", "dist", kv_url, grpc_port=grpc_port,
+                multitenant=multitenant)
     procs.append(dist)
     procs.append(Proc(tmp, "querier", "querier", kv_url,
-                      extra=f"frontend_address: {kv_url}\n"))
+                      extra=f"frontend_address: {kv_url}\n",
+                      multitenant=multitenant))
     for p in procs[1:]:
         p.wait_ready()
     time.sleep(1.0)  # let ring heartbeats settle
@@ -435,13 +444,13 @@ class OpStats:
 
 
 def _request(url: str, method: str = "GET", body: bytes | None = None,
-             ct: str = "", timeout: float = 60.0):
+             ct: str = "", timeout: float = 60.0, headers: dict | None = None):
     """-> (status, headers dict) — 4xx/5xx come back as a status, not an
     exception, so the callers can classify sheds."""
-    req = urllib.request.Request(
-        url, data=body, method=method,
-        headers={"Content-Type": ct} if ct else {},
-    )
+    h = dict(headers or {})
+    if ct:
+        h["Content-Type"] = ct
+    req = urllib.request.Request(url, data=body, method=method, headers=h)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
             r.read()
@@ -451,13 +460,26 @@ def _request(url: str, method: str = "GET", body: bytes | None = None,
         return e.code, dict(e.headers)
 
 
+def _get_json(url: str, timeout: float = 30.0, headers: dict | None = None):
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _org(tenant: str | None) -> dict:
+    return {"X-Scope-OrgID": tenant} if tenant else {}
+
+
 def run_mixed_load(write_url: str, query_url: str, duration_s: float,
                    rate: float, spans_per_trace: int = 5,
                    slo: dict | None = None, read_lag_s: float = 2.0,
-                   seed: int = 1):
-    """Drive the mixed workload; returns (summary dict, acked trace-id
-    list) — acked = writes the cluster ACCEPTED (HTTP 200), the set the
-    zero-loss gate verifies after the drain."""
+                   seed: int = 1, tenants: list | None = None):
+    """Drive the mixed workload; returns (summary dict, acked
+    (tenant, trace-id) list) — acked = writes the cluster ACCEPTED
+    (HTTP 200), the set the zero-loss gate verifies after the drain.
+    `tenants`: multi-tenant mode — every op carries one of these org
+    IDs round-robin by rng, and the attribution gate later verifies the
+    per-tenant cost split sums to the untagged ingest counters."""
     import random
     import threading
     import urllib.parse
@@ -506,20 +528,25 @@ def run_mixed_load(write_url: str, query_url: str, duration_s: float,
     seq = [0]
     seq_lock = threading.Lock()
 
+    def pick_tenant(rng):
+        return rng.choice(tenants) if tenants else None
+
     def do_write(rng):
         with seq_lock:
             seq[0] += 1
             i = seq[0]
+        tenant = pick_tenant(rng)
         traces = synth.make_traces(2, seed=seed * 1_000_000 + i,
                                    spans_per_trace=spans_per_trace)
         status, headers = _request(
             write_url + "/v1/traces", "POST",
-            otlp.encode_traces_request(traces), "application/x-protobuf")
+            otlp.encode_traces_request(traces), "application/x-protobuf",
+            headers=_org(tenant))
         outcome, hint_ok = classify(status, headers)
         if outcome == "ok":
             with acked_lock:
                 for t in traces:
-                    acked.append((time.monotonic(), t.trace_id))
+                    acked.append((time.monotonic(), tenant, t.trace_id))
         return outcome, hint_ok
 
     def pick_acked(rng):
@@ -529,13 +556,16 @@ def run_mixed_load(write_url: str, query_url: str, duration_s: float,
                 eligible -= 1
             if not eligible:
                 return None
-            return acked[rng.randrange(eligible)][1]
+            _, tenant, tid = acked[rng.randrange(eligible)]
+            return tenant, tid
 
     def do_find(rng):
-        tid = pick_acked(rng)
-        if tid is None:
+        picked = pick_acked(rng)
+        if picked is None:
             return "ok", True  # nothing acked yet; not a failure
-        status, headers = _request(f"{query_url}/api/traces/{tid.hex()}")
+        tenant, tid = picked
+        status, headers = _request(f"{query_url}/api/traces/{tid.hex()}",
+                                   headers=_org(tenant))
         return classify(status, headers)
 
     def do_search_live(rng):
@@ -545,7 +575,8 @@ def run_mixed_load(write_url: str, query_url: str, duration_s: float,
             "tags": f"service.name={svc}", "start": now - 300, "end": now + 5,
             "limit": 10,
         })
-        status, headers = _request(f"{query_url}/api/search?{qs}")
+        status, headers = _request(f"{query_url}/api/search?{qs}",
+                                   headers=_org(pick_tenant(rng)))
         return classify(status, headers)
 
     def do_search_hist(rng):
@@ -555,7 +586,8 @@ def run_mixed_load(write_url: str, query_url: str, duration_s: float,
             "tags": f"service.name={svc}",
             "start": now - 7200, "end": now - 3600, "limit": 10,
         })
-        status, headers = _request(f"{query_url}/api/search?{qs}")
+        status, headers = _request(f"{query_url}/api/search?{qs}",
+                                   headers=_org(pick_tenant(rng)))
         return classify(status, headers)
 
     def do_query_range(rng):
@@ -564,7 +596,8 @@ def run_mixed_load(write_url: str, query_url: str, duration_s: float,
             "q": "{} | rate() by (resource.service.name)",
             "start": end - 300, "end": end, "step": 2,
         })
-        status, headers = _request(f"{query_url}/api/metrics/query_range?{qs}")
+        status, headers = _request(f"{query_url}/api/metrics/query_range?{qs}",
+                                   headers=_org(pick_tenant(rng)))
         return classify(status, headers)
 
     fns = {"write": do_write, "find": do_find, "search_live": do_search_live,
@@ -582,40 +615,126 @@ def run_mixed_load(write_url: str, query_url: str, duration_s: float,
         t.join(timeout=5)
     ops, slo_pass = stats.summary(slo)
     with acked_lock:
-        acked_ids = [tid for _, tid in acked]
+        acked_ids = [(tenant, tid) for _, tenant, tid in acked]
     return {"ops": ops, "slo_pass": slo_pass, "acked_writes": len(acked_ids)}, acked_ids
 
 
 def verify_acked(query_url: str, acked_ids: list, sample: int = 25,
                  timeout_s: float = 45.0, seed: int = 1) -> dict:
     """Zero-acknowledged-loss gate: a random sample of ACCEPTED writes
-    must become queryable once ingest drains. Anything the cluster shed
-    (429) was never acked and is exempt by construction."""
+    must become queryable once ingest drains (under the tenant that
+    wrote them). Anything the cluster shed (429) was never acked and is
+    exempt by construction."""
     import random
 
     rng = random.Random(seed)
     ids = list(dict.fromkeys(acked_ids))
     if len(ids) > sample:
         ids = rng.sample(ids, sample)
-    pending = {tid for tid in ids}
+    pending = set(ids)
     deadline = time.time() + timeout_s
     while pending and time.time() < deadline:
-        for tid in list(pending):
+        for tenant, tid in list(pending):
             try:
-                status, _ = _request(f"{query_url}/api/traces/{tid.hex()}", timeout=10)
+                status, _ = _request(f"{query_url}/api/traces/{tid.hex()}",
+                                     timeout=10, headers=_org(tenant))
             except Exception:
                 # connection-level blip while the cluster drains the
                 # backlog: keep polling until the deadline
                 continue
             if status == 200:
-                pending.discard(tid)
+                pending.discard((tenant, tid))
         if pending:
             time.sleep(0.5)
     return {
         "sampled": len(ids),
         "lost": len(pending),
-        "lost_ids": sorted(t.hex() for t in pending)[:10],
+        "lost_ids": sorted(t.hex() for _, t in pending)[:10],
         "passed": not pending,
+    }
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant attribution gate + storage-health summary
+# ---------------------------------------------------------------------------
+
+def _parse_counter_series(text: str, family: str) -> dict:
+    """{labelstr: value} for one family out of a /metrics exposition."""
+    import re
+
+    out = {}
+    pat = re.compile(r"^%s\{([^}]*)\}\s+(\S+)$" % re.escape(family))
+    for line in text.splitlines():
+        m = pat.match(line)
+        if m:
+            out[m.group(1)] = float(m.group(2))
+    return out
+
+
+def attribution_check(dist_url: str, query_url: str, tenants: list) -> dict:
+    """Multi-tenant gate: the per-tenant cost split must be EXACT.
+
+    - At the distributor: sum over tenants of /status/usage ingest
+      ingested_bytes == the untagged total of
+      tempo_distributor_bytes_received_total on /metrics, and the two
+      views agree per tenant (counters and accountant are one number).
+    - At the frontend: every driven tenant shows up in /status/usage
+      with query-side cost (the worker->frontend usage wire survived a
+      real multi-process broker round trip)."""
+    import re
+
+    with urllib.request.urlopen(dist_url + "/metrics", timeout=15) as r:
+        met = r.read().decode()
+    series = _parse_counter_series(met, "tempo_distributor_bytes_received_total")
+    by_tenant = {}
+    for labels, v in series.items():
+        m = re.search(r'tenant="([^"]*)"', labels)
+        if m:
+            by_tenant[m.group(1)] = by_tenant.get(m.group(1), 0.0) + v
+    dist_usage = _get_json(dist_url + "/status/usage")["tenants"]
+    usage_by_tenant = {
+        t: doc["kinds"].get("ingest", {}).get("ingested_bytes", 0.0)
+        for t, doc in dist_usage.items()
+    }
+    mismatches = {
+        t: (by_tenant.get(t, 0.0), usage_by_tenant.get(t, 0.0))
+        for t in set(by_tenant) | set(usage_by_tenant)
+        if abs(by_tenant.get(t, 0.0) - usage_by_tenant.get(t, 0.0)) > 0.5
+    }
+    ingest_exact = not mismatches
+    sum_exact = abs(sum(by_tenant.values()) - sum(usage_by_tenant.values())) <= 0.5
+
+    front_usage = _get_json(query_url + "/status/usage")["tenants"]
+    uncovered = [
+        t for t in tenants
+        if not front_usage.get(t, {}).get("kinds")
+    ]
+    return {
+        "ingest_bytes_by_tenant": usage_by_tenant,
+        "counter_total": sum(by_tenant.values()),
+        "attributed_total": sum(usage_by_tenant.values()),
+        "mismatches": mismatches,
+        "tenants_without_query_usage": uncovered,
+        "passed": bool(ingest_exact and sum_exact and not uncovered),
+    }
+
+
+def storage_summary(query_url: str) -> dict:
+    """Fleet storage health from the frontend's /status/storage — the
+    same compression/debt/zone-map numbers bench_suite emits, so CI
+    tracks storage health alongside perf."""
+    try:
+        doc = _get_json(query_url + "/status/storage?refresh=1", timeout=60)
+    except Exception as e:  # noqa: BLE001 — summary is best-effort
+        return {"error": str(e)}
+    fleet = doc.get("fleet", {})
+    return {
+        "blocks": fleet.get("blocks"),
+        "total_bytes": fleet.get("totalBytes"),
+        "compression_ratio": fleet.get("compressionRatio"),
+        "zonemap_coverage": fleet.get("zonemapCoverageRatio"),
+        "debt_row_groups": fleet.get("compactionDebtRowGroups"),
+        "debt_payoff": fleet.get("compactionDebtPayoff"),
     }
 
 
@@ -690,7 +809,14 @@ def main() -> int:
     ap.add_argument("--query-range", action="store_true",
                     help="probe /api/metrics/query_range after the load "
                          "and gate on matrix responses")
+    ap.add_argument("--tenants", type=int, default=1,
+                    help=">1 enables multi-tenant mode: the cluster boots "
+                         "with multitenancy, every op carries one of N org "
+                         "IDs, and the run gates on attribution exactness "
+                         "(per-tenant cost vectors == untagged counters)")
     args = ap.parse_args()
+    multitenant = args.tenants > 1
+    tenant_ids = [f"lt-tenant-{i}" for i in range(args.tenants)] if multitenant else None
 
     procs: list[Proc] = []
     tmpdir = None
@@ -706,12 +832,20 @@ def main() -> int:
             write_url = query_url = args.url
         else:
             tmpdir = tempfile.mkdtemp(prefix="tempo-loadtest-")
-            procs, front, dist = start_cluster(tmpdir, grpc_port=grpc_port)
+            procs, front, dist = start_cluster(tmpdir, grpc_port=grpc_port,
+                                               multitenant=multitenant)
             write_url, query_url = dist.url, front.url
-            print(f"[loadtest] cluster up: write={write_url} query={query_url}",
+            print(f"[loadtest] cluster up: write={write_url} query={query_url}"
+                  + (f" tenants={args.tenants}" if multitenant else ""),
                   file=sys.stderr)
 
         sweep = {}
+        if multitenant and not args.skip_sweep:
+            # the receiver sweep drives org-less protocol shims; with
+            # multitenancy on those are 401 by design — skip it
+            args.skip_sweep = True
+            print("[loadtest] multi-tenant mode: receiver sweep skipped",
+                  file=sys.stderr)
         if not args.skip_sweep:
             sweep = receiver_sweep(write_url, query_url, grpc_port=grpc_port if procs else 0)
             print(f"[loadtest] receiver sweep: {sweep}", file=sys.stderr)
@@ -721,7 +855,7 @@ def main() -> int:
         slo = {op: (p99 * args.slo_scale, err) for op, (p99, err) in DEFAULT_SLO.items()}
         summary, acked_ids = run_mixed_load(
             write_url, query_url, duration_s=args.duration, rate=args.rate,
-            spans_per_trace=args.spans_per_trace, slo=slo,
+            spans_per_trace=args.spans_per_trace, slo=slo, tenants=tenant_ids,
         )
         print(f"[loadtest] mixed load done: {summary['acked_writes']} acked writes, "
               f"slo_pass={summary['slo_pass']}", file=sys.stderr)
@@ -741,10 +875,19 @@ def main() -> int:
             print(f"[loadtest] query_range probe: {qr}", file=sys.stderr)
             summary["query_range"] = qr
             sweep_ok = sweep_ok and qr["passed"]
+        attribution_ok = True
+        if multitenant:
+            attr = attribution_check(write_url, query_url, tenant_ids)
+            summary["attribution"] = attr
+            attribution_ok = attr["passed"]
+            print(f"[loadtest] attribution gate: {attr}", file=sys.stderr)
+        summary["storage"] = storage_summary(query_url)
+        print(f"[loadtest] storage health: {summary['storage']}", file=sys.stderr)
         summary["passed"] = bool(
             summary["slo_pass"]
             and loss["passed"]
             and sweep_ok
+            and attribution_ok
             and (rss is None or summary["rss"]["passed"])
         )
         print(json.dumps(summary))
