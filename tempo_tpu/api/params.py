@@ -23,6 +23,7 @@ PATH_SEARCH_TAGS = "/api/search/tags"
 PATH_SEARCH_TAG_VALUES = "/api/search/tag"  # + /{name}/values
 PATH_METRICS_QUERY_RANGE = "/api/metrics/query_range"
 PATH_USAGE = "/api/usage"  # tenant-scoped cost rollup
+PATH_QUERY_INSIGHTS = "/api/query-insights"  # tenant-scoped query records
 PATH_ECHO = "/api/echo"
 
 _DUR_RE = re.compile(r"([0-9]*\.?[0-9]+)(ns|us|µs|ms|s|m|h)")

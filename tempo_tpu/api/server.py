@@ -355,6 +355,27 @@ class _Handler(BaseHTTPRequestHandler):
                 "total": doc.get("total", {}),
             })
             return 200
+        if path == api_params.PATH_QUERY_INSIGHTS:
+            # the query-insights ring (util/insights): sampled + slow/
+            # error-triggered per-query records. Tenant-scoped like
+            # /api/usage — a tenant sees only its own queries; the
+            # burn -> insights -> `_self_` waterfall recipe lives in the
+            # runbook ("Reading query insights")
+            if app.frontend is None:
+                raise RoleUnavailable(
+                    f"this process (target={app.target}) serves no queries")
+            from tempo_tpu.util import insights as insights_mod
+
+            tenant = app.resolve_tenant(self._org_id())
+            try:
+                limit = int(qs.get("limit", ["50"])[0])
+            except ValueError as e:
+                raise BadRequest(f"bad limit: {e}") from e
+            self._send_json(200, {
+                "tenant": tenant,
+                "insights": insights_mod.LOG.snapshot(tenant, limit=limit),
+            })
+            return 200
         if path == api_params.PATH_ECHO:
             self._send(200, b"echo", "text/plain; charset=utf-8")
             return 200
@@ -503,6 +524,18 @@ class _Handler(BaseHTTPRequestHandler):
                 scanner = app.storage_scanner = StorageScanner(db)
             refresh = qs.get("refresh", ["0"])[0] not in ("0", "", "false")
             self._send_json(200, scanner.report(max_age_s=0 if refresh else None))
+            return 200
+        if path == "/status/slo":
+            # the burn-rate SLO engine's accounting document (util/slo):
+            # per objective, the cumulative good/total the SLIs derive
+            # from, every window's burn rate, error-budget spend over
+            # the 3d window, and which multi-window alerts are burning.
+            # Computed fresh on each request (sampling is cheap).
+            eng = getattr(app, "slo_engine", None)
+            if eng is None:
+                self._send_json(200, {"enabled": False})
+            else:
+                self._send_json(200, eng.status())
             return 200
         if path == "/status/usage-stats":
             # current anonymous usage report (reference: PathUsageStats,
@@ -665,6 +698,7 @@ _ENDPOINTS = [
     "GET /api/search/tag/{name}/values",
     "GET /api/metrics/query_range",
     "GET /api/usage",
+    "GET /api/query-insights",
     "GET /api/echo",
     "GET /ready",
     "GET /metrics",
@@ -677,6 +711,7 @@ _ENDPOINTS = [
     "GET /status/profile/device",
     "GET /status/usage",
     "GET /status/usage-stats",
+    "GET /status/slo",
     "GET /status/storage",
     "GET /status/runtime_config",
     "POST /flush",

@@ -36,7 +36,8 @@ from tempo_tpu.modules.worker import JobBroker, LocalWorkerPool, RemoteWorker
 from tempo_tpu.util import devicetiming  # noqa: F401 — registers the
 # device-dispatch histograms so /metrics exposes them from boot, not
 # from the first dispatch
-from tempo_tpu.util import resource, tracing
+from tempo_tpu.util import resource, slo, tracing
+from tempo_tpu.vulture import VultureConfig
 
 log = logging.getLogger(__name__)
 
@@ -50,6 +51,7 @@ ROLES = (
     "query-frontend",
     "compactor",
     "metrics-generator",
+    "vulture",
 )
 
 
@@ -98,6 +100,13 @@ class AppConfig:
     self_tracing: "tracing.SelfTracingConfig" = field(
         default_factory=tracing.SelfTracingConfig
     )
+    # continuous-verification prober (vulture.py): enabled=True arms it
+    # in-process on target=all; `-target=vulture` builds the HTTP
+    # sidecar against vulture.target
+    vulture: "VultureConfig" = field(default_factory=VultureConfig)
+    # burn-rate SLO engine (util/slo.py): SLIs over this process's own
+    # counters -> tempo_tpu_slo_* gauges + /status/slo
+    slo: "slo.SLOConfig" = field(default_factory=slo.SLOConfig)
 
 
 class RoleUnavailable(RuntimeError):
@@ -150,12 +159,17 @@ class App:
 
         self._self_exporter = None
         self._self_export_client = None
+        self.vulture = None
+        self.slo_engine = None
         if target == "all":
             self._build_all()
         else:
             self._build_role(target)
         self._maybe_self_tracing()
         self._maybe_storage_scanner()
+        self._maybe_vulture()
+        if cfg.slo.enabled:
+            self.slo_engine = slo.SLOEngine(cfg.slo)
 
     # ------------------------------------------------------------------
     def _hb_period(self) -> float:
@@ -333,7 +347,46 @@ class App:
             self.rpc = RPCHandler()
             return
 
+        if role == "vulture":
+            # sidecar deployment (reference: cmd/tempo-vulture beside the
+            # cluster): pushes to vulture.target over OTLP/HTTP and reads
+            # via vulture.query_target (frontend) — its own /metrics
+            # listener exports the tempo_vulture_* families prometheus
+            # scrapes, and slo.enabled here judges exactly those
+            from tempo_tpu.vulture import HTTPClient, Vulture
+
+            vcfg = cfg.vulture
+            target = vcfg.target or cfg.frontend_address
+            if not target:
+                raise ValueError(
+                    "target=vulture requires vulture.target (cluster base URL)")
+            client = HTTPClient(
+                target,
+                tenant=vcfg.tenant if cfg.multitenancy_enabled else None,
+                query_url=vcfg.query_target or None,
+            )
+            self.vulture = Vulture(client, cfg=vcfg)
+            self.rpc = RPCHandler()
+            return
+
         raise AssertionError(role)
+
+    def _maybe_vulture(self):
+        """In-process prober on the all-in-one target (the reference
+        runs tempo-vulture as a sidecar; a single binary can dogfood it
+        directly — vulture.enabled in config)."""
+        if self.target != "all" or not self.cfg.vulture.enabled:
+            return
+        from tempo_tpu.vulture import InProcessClient, Vulture
+
+        # same tenant plumbing as the sidecar branch: with multitenancy
+        # on, an org-less push/query would 401 every probe
+        client = InProcessClient(
+            self,
+            tenant=self.cfg.vulture.tenant if self.cfg.multitenancy_enabled
+            else None,
+        )
+        self.vulture = Vulture(client, cfg=self.cfg.vulture)
 
     def _maybe_self_tracing(self):
         """Close the dogfood loop: the global tracer exports finished
@@ -502,6 +555,10 @@ class App:
             self.usage_reporter.start_loop()
         if self.storage_scanner is not None:
             self.storage_scanner.start()
+        if self.vulture is not None:
+            self.vulture.start()
+        if self.slo_engine is not None:
+            self.slo_engine.start()
 
     def sweep_all(self, immediate: bool = False):
         """Deterministic maintenance for tests/drives."""
@@ -510,7 +567,8 @@ class App:
 
     def service_states(self) -> dict:
         states = {"target": self.target}
-        for name in ("distributor", "querier", "frontend", "compactor", "generator"):
+        for name in ("distributor", "querier", "frontend", "compactor",
+                     "generator", "vulture", "slo_engine"):
             if getattr(self, name) is not None:
                 states[name] = "Running"
         for iid in self.ingesters:
@@ -528,6 +586,13 @@ class App:
         if self._self_export_client is not None:
             self._self_export_client.close()
             self._self_export_client = None
+        # the prober and SLO engine go down BEFORE the rings/KVs: a
+        # check racing the half-dismantled app would record phantom
+        # data-loss errors into the very counters alerting watches
+        if self.vulture is not None:
+            self.vulture.stop()
+        if self.slo_engine is not None:
+            self.slo_engine.stop()
         for stop in self._heartbeat_stops:
             stop.set()
         for ring, iid in self._registered:

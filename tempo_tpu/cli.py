@@ -362,6 +362,75 @@ def cmd_analyse_blocks(args) -> int:
     return 0
 
 
+# -- vulture ---------------------------------------------------------------
+
+
+def cmd_vulture_check(args) -> int:
+    """Offline aged-tier audit: recompute the deterministic vulture
+    probes (util/traceinfo) whose cadence timestamps fall inside the
+    tenant's stored block range and verify each is present and complete
+    DIRECTLY against the backend blocks — no running cluster. This is
+    the post-compaction arm of the continuous-verification plane: the
+    live vulture proves the query path, this proves the bytes at rest.
+
+    The audit assumes the prober wrote EVERY cadence slot of the
+    audited window — bound it with --since/--until to the interval the
+    vulture actually ran (its start time / last stop), or every slot of
+    a gap reads as MISSING (a false data-loss verdict).
+    """
+    from tempo_tpu import encoding as encoding_registry
+    from tempo_tpu.util.traceinfo import TraceInfo
+
+    be = _backend(args)
+    metas, _ = _tenant_metas(be, args.tenant)
+    if not metas:
+        print("no blocks for tenant", file=sys.stderr)
+        return 1
+    lo = min(m.start_time for m in metas)
+    hi = max(m.end_time for m in metas)
+    if args.since:
+        lo = max(lo, args.since)
+    if args.until:
+        hi = min(hi, args.until)
+    backoff = max(1, args.write_backoff)
+    first = lo + (-lo) % backoff  # first cadence-aligned ts >= lo
+    timestamps = list(range(first, hi + 1, backoff))
+    if args.max_probes and len(timestamps) > args.max_probes:
+        timestamps = timestamps[-args.max_probes:]  # newest-biased window
+    if not timestamps:
+        print("no cadence slots inside the audited window", file=sys.stderr)
+        return 0
+    # only open blocks that can overlap an audited slot — a bounded
+    # audit must not pay index reads for the whole tenant
+    lo, hi = timestamps[0], timestamps[-1]
+    metas = [m for m in metas if m.end_time >= lo and m.start_time <= hi + 2]
+    blocks = [encoding_registry.from_version(m.version).open_block(m, be)
+              for m in metas]
+    found = missing = incomplete = 0
+    for ts in timestamps:
+        info = TraceInfo(ts, args.seed_tenant)
+        want = {s.span_id for s in info.construct_trace().all_spans()}
+        got: set = set()
+        for m, blk in zip(metas, blocks):
+            if m.end_time < ts or m.start_time > ts + 2:
+                continue
+            t = blk.find_trace_by_id(info.trace_id())
+            if t is not None:
+                got |= {s.span_id for s in t.all_spans()}
+        if not got:
+            missing += 1
+            print(f"MISSING  ts={ts} trace={info.trace_id().hex()}")
+        elif not want <= got:
+            incomplete += 1
+            print(f"PARTIAL  ts={ts} trace={info.trace_id().hex()} "
+                  f"({len(want & got)}/{len(want)} spans)")
+        else:
+            found += 1
+    print(f"probes={len(timestamps)} found={found} missing={missing} "
+          f"incomplete={incomplete}")
+    return 0 if not (missing or incomplete) else 1
+
+
 # -- gen -------------------------------------------------------------------
 
 
@@ -510,6 +579,25 @@ def build_parser() -> argparse.ArgumentParser:
     abs_.add_argument("--window-s", type=int, default=3600,
                       help="compaction window for the debt sweep")
     abs_.set_defaults(fn=cmd_analyse_blocks)
+
+    vc = sub.add_parser(
+        "vulture-check",
+        help="offline audit of deterministic vulture probes in stored blocks",
+    )
+    vc.add_argument("tenant")
+    vc.add_argument("--seed-tenant", default="single-tenant",
+                    help="tenant string the probes were seeded with "
+                         "(vulture.tenant of the writing prober)")
+    vc.add_argument("--write-backoff", type=int, default=10,
+                    help="the writing vulture's cadence in seconds")
+    vc.add_argument("--max-probes", type=int, default=500,
+                    help="check at most the newest N cadence timestamps")
+    vc.add_argument("--since", type=int, default=0,
+                    help="audit slots at/after this unix second (bound "
+                         "to when the prober actually started writing)")
+    vc.add_argument("--until", type=int, default=0,
+                    help="audit slots at/before this unix second")
+    vc.set_defaults(fn=cmd_vulture_check)
 
     gen = sub.add_parser("gen", help="regenerate derived objects").add_subparsers(dest="what", required=True)
     gb = gen.add_parser("bloom")

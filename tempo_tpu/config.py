@@ -30,8 +30,10 @@ from tempo_tpu.modules.generator.storage import RemoteWriteConfig
 from tempo_tpu.modules.ingester import IngesterConfig
 from tempo_tpu.modules.overrides import Limits
 from tempo_tpu.usagestats import UsageStatsConfig
+from tempo_tpu.util import slo as slo_mod
 from tempo_tpu.util.resource import ResourceConfig
 from tempo_tpu.util.tracing import SelfTracingConfig
+from tempo_tpu.vulture import VultureConfig
 
 log = logging.getLogger(__name__)
 
@@ -187,6 +189,20 @@ def parse_config(text: str, env: dict | None = None) -> Config:
     # self-observability: the engine traces itself into `_self_`
     app.self_tracing = _from_dict(
         SelfTracingConfig, doc.pop("self_tracing", None), "self_tracing")
+    # continuous-verification prober (in-process on target=all, or the
+    # whole process when target=vulture)
+    app.vulture = _from_dict(VultureConfig, doc.pop("vulture", None), "vulture")
+    # burn-rate SLO engine; objectives is a LIST of dataclasses, handled
+    # like distributor.forwarders
+    slo_doc = doc.pop("slo", {}) or {}
+    if not isinstance(slo_doc, dict):
+        raise ConfigError("slo: expected a mapping")
+    obj_list = slo_doc.pop("objectives", []) or []
+    app.slo = _from_dict(slo_mod.SLOConfig, slo_doc, "slo")
+    app.slo.objectives = [
+        _from_dict(slo_mod.SLOObjective, o, f"slo.objectives[{i}]")
+        for i, o in enumerate(obj_list)
+    ]
 
     for key in ("replication_factor", "n_ingesters", "query_workers"):
         if key in doc:
@@ -277,4 +293,53 @@ def check_config(cfg: Config) -> list[str]:
             f"ceiling ({resident_cap} bytes = query_shards x target_bytes_per_job): "
             "two concurrent broad queries cannot both be admitted"
         )
+    # -- continuous-verification plane ----------------------------------
+    vulture_armed = app.vulture.enabled or cfg.target == "vulture"
+    if vulture_armed:
+        # the aged tier exists to pin POST-COMPACTION blocks: a probe
+        # must be old enough that its block was cut from the WAL head
+        # AND swept through at least one compaction window before the
+        # aged check picks it — otherwise "aged" silently re-tests the
+        # recent tier and compaction bugs go unwatched
+        compaction_cycle_s = (app.ingester.max_block_duration_s
+                              + app.db.compaction.window_s)
+        if app.vulture.aged_min_age_s < compaction_cycle_s:
+            warnings.append(
+                f"vulture.aged_min_age_s ({app.vulture.aged_min_age_s}s) is "
+                "shorter than one block-cut + compaction cycle "
+                f"(ingester.max_block_duration_s + compaction window = "
+                f"{compaction_cycle_s:g}s): aged-tier probes will not "
+                "outlive a compaction cycle and cannot pin that tier"
+            )
+        if app.vulture.retention_s <= app.vulture.aged_min_age_s:
+            warnings.append(
+                f"vulture.retention_s ({app.vulture.retention_s}s) <= "
+                f"aged_min_age_s ({app.vulture.aged_min_age_s}s): the aged "
+                "tier window is empty and aged checks will never run"
+            )
+        if app.vulture.write_backoff_s > app.vulture.recent_min_age_s:
+            warnings.append(
+                f"vulture.write_backoff_s ({app.vulture.write_backoff_s}s) "
+                f"exceeds recent_min_age_s ({app.vulture.recent_min_age_s}s): "
+                "some cycles have no fresh-tier probe to check"
+            )
+    if app.slo.enabled:
+        for obj in (app.slo.objectives or slo_mod.default_objectives()):
+            if obj.sli not in slo_mod.SLI_SOURCES:
+                warnings.append(
+                    f"slo objective {obj.name!r} references unknown SLI "
+                    f"source {obj.sli!r} (have "
+                    f"{sorted(slo_mod.SLI_SOURCES)}): it will never leave 100%"
+                )
+            elif obj.sli in ("vulture", "freshness") and not vulture_armed:
+                warnings.append(
+                    f"slo objective {obj.name!r} consumes the {obj.sli} SLI "
+                    "but no vulture runs in this process "
+                    "(vulture.enabled=false): its counters will stay empty"
+                )
+            if not (0.0 < obj.objective < 1.0):
+                warnings.append(
+                    f"slo objective {obj.name!r} target {obj.objective} is "
+                    "outside (0, 1): burn rates are undefined"
+                )
     return warnings

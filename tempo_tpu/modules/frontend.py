@@ -24,7 +24,7 @@ from dataclasses import dataclass
 from tempo_tpu.encoding.common import SearchRequest, SearchResponse, TraceSearchMetadata
 from tempo_tpu.model.trace import combine_traces
 from tempo_tpu.modules.worker import JobBroker, decode_trace_result
-from tempo_tpu.util import metrics, resource, stagetimings, tracing, usage
+from tempo_tpu.util import insights, metrics, resource, stagetimings, tracing, usage
 
 log = logging.getLogger(__name__)
 
@@ -82,6 +82,13 @@ class FrontendConfig:
     # live-tail and recent-window queries keep flowing until the
     # inflight-bytes pool itself is full. 0 disables the class split.
     shed_historical_above_bytes: int = 1 << 30
+    # -- query-insights log (util/insights): bounded ring of per-query
+    # records behind /api/query-insights + the JSON slow-query log.
+    # Errors/partials/slow queries always captured; healthy fast ones
+    # sampled 1-in-N.
+    insights_capacity: int = 512
+    insights_sample_every: int = 10
+    insights_slow_threshold_s: float = 2.0
 
 
 class Frontend:
@@ -97,6 +104,13 @@ class Frontend:
         self.governor = governor or resource.governor()
         self._adm_lock = threading.Lock()
         self._tenant_inflight: dict[str, int] = {}
+        # the process-wide insight ring adopts this frontend's knobs
+        # (one frontend per process owns query-path observability)
+        insights.LOG.configure(
+            capacity=self.cfg.insights_capacity,
+            sample_every=self.cfg.insights_sample_every,
+            slow_threshold_s=self.cfg.insights_slow_threshold_s,
+        )
 
     # ------------------------------------------------------------------
     # admission: every query passes here BEFORE any job is sharded.
@@ -220,6 +234,9 @@ class Frontend:
         # stage waterfall (wall clock: workers may be remote, but they
         # share the deployment's clock discipline)
         tp = tracing.current_traceparent()
+        # the insight record learns its shard count and traceparent here
+        # — every query path funnels through this submit
+        insights.note(shards=len(descs), traceparent=tp)
         now_ts = time.time()
         descs = [
             {**d, "deadline": deadline_ts, "submitted_at": now_ts,
@@ -380,7 +397,8 @@ class Frontend:
     def find_trace_by_id(self, tenant: str, trace_id: bytes):
         """Shard the blockID space + one ingester job; combine partials,
         dedupe spans (reference: newTraceByIDMiddleware frontend.go:97)."""
-        with stagetimings.request() as st, usage.attribute(tenant, "find"):
+        with stagetimings.request() as st, usage.attribute(tenant, "find"), \
+                insights.LOG.observe(tenant, "find", "trace-by-id"):
             with tracing.span("frontend/find", tenant=tenant,
                               trace=trace_id.hex()):
                 out = self._find_traced(tenant, trace_id)
@@ -416,9 +434,14 @@ class Frontend:
     def search(self, tenant: str, req: SearchRequest) -> SearchResponse:
         """Ingester window job + one job per chunk of backend blocks
         (reference: searchsharding.go:266 backendRequests)."""
-        with stagetimings.request() as st, usage.attribute(tenant, "search"):
+        with stagetimings.request() as st, usage.attribute(tenant, "search"), \
+                insights.LOG.observe(tenant, "search",
+                                     insights.normalize_search(req)) as rec:
             with tracing.span("frontend/search", tenant=tenant):
                 out = self._search_traced(tenant, req)
+            rec["status"] = out.status
+            if out.failed_shards:
+                rec["failedShards"] = out.failed_shards
             wire = st.to_wire()
             out.stage_seconds = wire["stageSeconds"]
             out.device_dispatches = wire["deviceDispatches"]
@@ -494,11 +517,16 @@ class Frontend:
         segments (the not-yet-flushed tail); block jobs cover flushed
         data, the same disjointness contract the search path uses.
         """
-        with stagetimings.request() as st, usage.attribute(tenant, "query_range"):
+        with stagetimings.request() as st, usage.attribute(tenant, "query_range"), \
+                insights.LOG.observe(tenant, "query_range",
+                                     insights.normalize_query(query)) as rec:
             with tracing.span("frontend/query_range", tenant=tenant):
                 mat = self._query_range_traced(
                     tenant, query, start_s, end_s, step_s,
                     max_series=max_series, exemplars=exemplars)
+            if mat.get("status") == "partial":
+                rec["status"] = "partial"
+                rec["failedShards"] = mat.get("failedShards", 0)
             wire = st.to_wire()
             stats = mat.setdefault("stats", {})
             stats["stageSeconds"] = wire["stageSeconds"]
@@ -586,7 +614,9 @@ class Frontend:
     # ------------------------------------------------------------------
     def traceql(self, tenant: str, query: str, start_s=0, end_s=0, limit=20,
                 stats: dict | None = None):
-        with stagetimings.request() as st, usage.attribute(tenant, "traceql"):
+        with stagetimings.request() as st, usage.attribute(tenant, "traceql"), \
+                insights.LOG.observe(tenant, "traceql",
+                                     insights.normalize_query(query)):
             with tracing.span("frontend/traceql", tenant=tenant, q=query):
                 out = self._traceql_traced(tenant, query, start_s, end_s,
                                            limit, stats)

@@ -209,6 +209,13 @@ class Registry:
                 raise ValueError(f"metric {name} already registered as {type(m).__name__}")
             return m
 
+    def get(self, name: str):
+        """Registered metric by name, or None — NEVER creates (the SLO
+        engine reads families other modules own; a lookup must not
+        register an empty-help family that wins the name)."""
+        with self._lock:
+            return self._metrics.get(name)
+
     def counter(self, name: str, help_: str = "") -> Counter:
         return self._get_or_make(Counter, name, help_)
 

@@ -39,12 +39,47 @@ class TraceInfo:
         return a.to_bytes(8, "big") + b.to_bytes(8, "big")
 
     def construct_trace(self) -> Trace:
-        """The exact trace the vulture wrote at timestamp_s."""
-        return synth.make_trace(
+        """The exact trace the vulture wrote at timestamp_s. Every span
+        carries a `vulture` attribute holding the probe timestamp so the
+        TraceQL / query_range checks can select EXACTLY this probe's
+        spans out of shared tenant traffic — the attribute is part of
+        the deterministic construction, so writer and checker agree on
+        it with no state file."""
+        trace = synth.make_trace(
             seed=self.seed,
             base_time_ns=self.timestamp_s * 10**9,
             trace_id=self.trace_id(),
         )
+        stamp = str(self.timestamp_s)
+        for span in trace.all_spans():
+            span.attributes["vulture"] = stamp
+        return trace
+
+    # -- recomputable expectations for the metrics/TraceQL checks -------
+    def traceql_query(self) -> str:
+        """TraceQL selecting exactly this probe's spans."""
+        return '{ .vulture = "%d" }' % self.timestamp_s
+
+    def metrics_query(self) -> str:
+        """query_range pipeline counting this probe's spans per bin."""
+        return self.traceql_query() + " | count_over_time()"
+
+    def expected_series(self, start_s: int, step_s: int) -> dict[int, int]:
+        """{bin_timestamp: span_count} the metrics engine must return for
+        metrics_query() over a range starting at start_s with step_s —
+        bins follow the engine's grid (start_s + k*step_s, span bucketed
+        by integer division on its start second). Only nonzero bins are
+        listed; zero bins compare as absent."""
+        out: dict[int, int] = {}
+        for span in self.construct_trace().all_spans():
+            sec = span.start_unix_nano // 10**9
+            b = (sec - start_s) // step_s
+            ts = start_s + b * step_s
+            out[ts] = out.get(ts, 0) + 1
+        return out
+
+    def span_count(self) -> int:
+        return sum(1 for _ in self.construct_trace().all_spans())
 
     def ready(self, now_s: int, write_backoff_s: int, long_write_backoff_s: int) -> bool:
         """Whether this timestamp is one the vulture would have written

@@ -1,0 +1,208 @@
+"""Query-insights log tests (util/insights + frontend + API): capture
+policy (error/partial/slow always, healthy sampled), normalization,
+ring bounds, the /api/query-insights surface, and the record contents
+the burn->insights->waterfall recipe depends on (stage waterfall, usage
+vector, traceparent, shard counts).
+"""
+
+import json
+import logging
+import urllib.request
+
+import pytest
+
+from tempo_tpu.api.server import TempoServer
+from tempo_tpu.app import App, AppConfig
+from tempo_tpu.db import DBConfig
+from tempo_tpu.encoding.common import SearchRequest
+from tempo_tpu.model import synth
+from tempo_tpu.modules.frontend import FrontendConfig
+from tempo_tpu.util import insights
+
+
+@pytest.fixture(autouse=True)
+def clean_log():
+    insights.LOG.clear()
+    yield
+    insights.LOG.clear()
+
+
+@pytest.fixture
+def app(tmp_path):
+    cfg = AppConfig(
+        db=DBConfig(backend="local", backend_path=str(tmp_path / "blocks"),
+                    wal_path=str(tmp_path / "wal")),
+        generator_enabled=False,
+        # capture EVERYTHING: sampling 1-in-1, slow threshold 0
+        frontend=FrontendConfig(insights_sample_every=1,
+                                insights_slow_threshold_s=0.0),
+    )
+    a = App(cfg)
+    yield a
+    a.shutdown()
+
+
+class TestNormalization:
+    def test_traceql_literals_stripped(self):
+        q = '{ resource.service.name = "cart" && duration > 250ms } | rate()'
+        n = insights.normalize_query(q)
+        assert "cart" not in n and "250" not in n
+        assert n == '{ resource.service.name = "?" && duration > ? } | rate()'
+
+    def test_tag_search_shape(self):
+        req = SearchRequest(tags={"service": "cart", "region": "eu"},
+                            min_duration_ns=5)
+        assert insights.normalize_search(req) == "tags:region,service duration:?"
+        assert insights.normalize_search(SearchRequest()) == "tags:<none>"
+
+    def test_query_rides_search(self):
+        req = SearchRequest(query='{ name = "x" }')
+        assert insights.normalize_search(req) == '{ name = "?" }'
+
+
+class TestCapturePolicy:
+    def test_ring_bounded(self):
+        log_ = insights.InsightLog(capacity=5, sample_every=1,
+                                   slow_threshold_s=999.0)
+        for i in range(20):
+            with log_.observe("t", "search", f"q{i}"):
+                pass
+        snap = log_.snapshot()
+        assert len(snap) == 5
+        # newest first
+        assert snap[0]["query"] == "q19"
+
+    def test_sampling_one_in_n(self):
+        log_ = insights.InsightLog(capacity=100, sample_every=10,
+                                   slow_threshold_s=999.0)
+        for _ in range(30):
+            with log_.observe("t", "search", "q"):
+                pass
+        assert len(log_.snapshot(limit=100)) == 3
+        assert all(r["captureReason"] == "sampled" for r in log_.snapshot())
+
+    def test_errors_always_captured_and_logged(self, caplog):
+        log_ = insights.InsightLog(capacity=10, sample_every=1000,
+                                   slow_threshold_s=999.0)
+        with caplog.at_level(logging.WARNING, logger="tempo_tpu.slowquery"):
+            for _ in range(3):
+                with pytest.raises(RuntimeError):
+                    with log_.observe("t", "search", "q"):
+                        raise RuntimeError("boom")
+        recs = log_.snapshot()
+        assert len(recs) == 3
+        assert all(r["status"] == "error" and r["captureReason"] == "error"
+                   for r in recs)
+        assert all("RuntimeError: boom" in r["error"] for r in recs)
+        # each emitted one parseable JSON slow-query line
+        lines = [r.message for r in caplog.records if "query-insight" in r.message]
+        assert len(lines) == 3
+        doc = json.loads(lines[0].split("query-insight ", 1)[1])
+        assert doc["status"] == "error"
+
+    def test_slow_always_captured(self):
+        log_ = insights.InsightLog(capacity=10, sample_every=1000,
+                                   slow_threshold_s=0.0)  # everything is slow
+        with log_.observe("t", "find", "trace-by-id"):
+            pass
+        recs = log_.snapshot()
+        assert recs and recs[0]["captureReason"] == "slow"
+
+    def test_partial_always_captured(self):
+        log_ = insights.InsightLog(capacity=10, sample_every=1000,
+                                   slow_threshold_s=999.0)
+        with log_.observe("t", "search", "q") as rec:
+            rec["status"] = "partial"
+            rec["failedShards"] = 2
+        recs = log_.snapshot()
+        assert recs and recs[0]["captureReason"] == "partial"
+        assert recs[0]["failedShards"] == 2
+
+
+class TestFrontendIntegration:
+    def test_search_record_contents(self, app):
+        app.push_traces(synth.make_traces(5, seed=3, spans_per_trace=3))
+        app.sweep_all(immediate=True)
+        app.db.poll_now()
+        app.search(SearchRequest(tags={"service": "frontend"}, limit=5))
+        recs = [r for r in insights.LOG.snapshot() if r["kind"] == "search"]
+        assert recs
+        r = recs[0]
+        assert r["tenant"] == "single-tenant"
+        assert r["query"] == "tags:service"
+        assert r["status"] == "complete"
+        assert r["durationSeconds"] > 0
+        assert r["shards"] >= 1  # learned inside _run_jobs
+        assert "stageSeconds" in r and isinstance(r["stageSeconds"], dict)
+        assert "usage" in r and r["usage"].get("inspected_bytes", 0) > 0
+
+    def test_every_kind_recorded(self, app):
+        import time as _time
+
+        traces = synth.make_traces(3, seed=5, spans_per_trace=3)
+        app.push_traces(traces)
+        app.sweep_all(immediate=True)
+        app.db.poll_now()
+        now = int(_time.time())
+        app.find_trace(traces[0].trace_id)
+        app.search(SearchRequest(tags={"service": "frontend"}, limit=5))
+        app.traceql('{ resource.service.name = "frontend" }')
+        app.query_range("{} | rate()", now - 300, now + 60, 60)
+        kinds = {r["kind"] for r in insights.LOG.snapshot(limit=100)}
+        assert kinds >= {"find", "search", "traceql", "query_range"}
+        ql = next(r for r in insights.LOG.snapshot(limit=100)
+                  if r["kind"] == "query_range")
+        assert ql["query"] == "{} | rate()"
+
+    def test_traceparent_recorded_when_traced(self, app):
+        from tempo_tpu.util import tracing
+
+        tracing.install_exporter(lambda traces: None)
+        try:
+            app.search(SearchRequest(tags={"service": "x"}, limit=1))
+        finally:
+            tracing.uninstall_exporter()
+        rec = insights.LOG.snapshot()[0]
+        assert rec.get("traceparent", "").startswith("00-")
+
+    def test_api_endpoint_tenant_scoped(self, app):
+        srv = TempoServer(app).start()
+        try:
+            app.search(SearchRequest(tags={"service": "x"}, limit=1))
+            with urllib.request.urlopen(srv.url + "/api/query-insights?limit=5") as r:
+                doc = json.loads(r.read())
+            assert doc["tenant"] == "single-tenant"
+            assert doc["insights"] and doc["insights"][0]["kind"] == "search"
+            # another tenant's view is empty (the `_self_` scope is
+            # addressable even in single-tenant mode)
+            req = urllib.request.Request(srv.url + "/api/query-insights",
+                                         headers={"X-Scope-OrgID": "_self_"})
+            with urllib.request.urlopen(req) as r:
+                doc2 = json.loads(r.read())
+            assert doc2["tenant"] == "_self_" and doc2["insights"] == []
+        finally:
+            srv.stop()
+
+    def test_endpoint_404_without_frontend(self, tmp_path):
+        cfg = AppConfig(target="vulture")
+        from tempo_tpu.vulture import VultureConfig
+
+        cfg.vulture = VultureConfig(enabled=True, target="http://127.0.0.1:1")
+        side = App(cfg)
+        srv = TempoServer(side).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(srv.url + "/api/query-insights")
+            assert ei.value.code == 404
+        finally:
+            srv.stop()
+            side.shutdown()
+
+    def test_error_query_recorded_via_frontend(self, app):
+        # a client error raised mid-query is captured as an error record
+        with pytest.raises(ValueError):
+            app.query_range("{} | rate()", 200, 100, 10)  # inverted range
+        recs = [r for r in insights.LOG.snapshot(limit=100)
+                if r["kind"] == "query_range"]
+        assert recs and recs[0]["status"] == "error"
+        assert "ValueError" in recs[0]["error"]

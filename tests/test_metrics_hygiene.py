@@ -175,6 +175,18 @@ FAMILY_SERIES_BUDGETS = {
     "tempodb_compaction_debt_payoff": 64,
     "tempodb_storage_compression_ratio": 64,
     "tempodb_storage_codec_stored_bytes": 16,  # codec enum
+    # continuous-verification plane: type x tier / check x tier enums
+    "tempo_vulture_check_total": 32,
+    "tempo_vulture_error_total": 32,
+    "tempo_vulture_freshness_seconds": 8,
+    # SLO engine: objective x window (objectives are config-bounded)
+    "tempo_tpu_slo_burn_rate": 64,
+    "tempo_tpu_slo_error_budget_remaining": 16,
+    "tempo_tpu_slo_sli_events": 16,
+    "tempo_tpu_slo_sli_good_events": 16,
+    "tempo_tpu_slo_burning": 32,
+    # query-insights capture counter: kind x reason enums
+    "tempo_tpu_query_insights_total": 32,
     # tenant x kind cost counters (usage accountant eviction bounds tenant)
     **{f"tempo_tpu_usage_{f}_total": 448 for f in (
         "ingested_bytes", "ingested_spans", "flushed_bytes",

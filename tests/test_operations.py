@@ -21,6 +21,20 @@ def test_docker_compose_config_parses():
     assert check_config(cfg) == []
 
 
+def test_docker_compose_vulture_sidecar_parses():
+    with open(os.path.join(OPS, "docker-compose", "vulture.yaml")) as f:
+        cfg = parse_config(f.read())
+    assert cfg.target == "vulture"
+    assert cfg.app.vulture.enabled and cfg.app.vulture.target
+    assert cfg.app.slo.enabled
+    assert [o.sli for o in cfg.app.slo.objectives] == ["vulture", "freshness"]
+    assert check_config(cfg) == []
+    # the compose file actually mounts it
+    with open(os.path.join(OPS, "docker-compose", "docker-compose.yaml")) as f:
+        compose = yaml.safe_load(f)
+    assert "vulture" in compose["services"]
+
+
 def test_kubernetes_configmap_config_parses():
     with open(os.path.join(OPS, "kubernetes", "tempo-tpu.yaml")) as f:
         docs = list(yaml.safe_load_all(f))

@@ -767,7 +767,7 @@ class TestOverloadSoak:
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         proc = subprocess.run(
             [sys.executable, "tools/loadtest.py", "--duration", "60",
-             "--rate", "10", "--skip-sweep",
+             "--rate", "10", "--skip-sweep", "--vulture",
              # this container shares its cores with the 5-process cluster
              # under test: keep the correctness gates (errors, shed
              # hints, acked loss, RSS) at full strength and scale only
@@ -785,7 +785,11 @@ class TestOverloadSoak:
         # bounded RSS, every shed hinted, error rates within budget
         assert summary["acked_loss"]["lost"] == 0, summary["acked_loss"]
         assert summary["rss"]["passed"], summary["rss"]
-        latency_ok = True
+        # the vulture arm's correctness gate is STRICT: every probe the
+        # cluster acked under 10x load must read back complete at drain
+        # (freshness is latency-shaped: folded into latency_ok below)
+        assert summary["vulture"]["gates"]["drain_correctness"], summary["vulture"]
+        latency_ok = summary["vulture"]["gates"]["freshness_slo"]
         for op, st in summary["ops"].items():
             assert st["gates"]["shed_hints"], f"{op}: shed without a retry hint"
             assert st["gates"]["error_rate"], f"{op}: error rate {st['error_rate']}"

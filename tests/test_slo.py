@@ -1,0 +1,364 @@
+"""Burn-rate SLO engine tests (util/slo.py): window math, error-budget
+accounting, and counter-reset tolerance against hand-computed fixtures,
+plus the /status/slo surface and its bit-exact consistency with the raw
+SLI counters it derives from.
+"""
+
+import urllib.request
+
+import pytest
+
+from tempo_tpu.util import metrics, slo
+
+
+def _engine(objective=0.999, name="fake", sli="fake-sli", threshold=0.0,
+            **cfg_kw):
+    eng = slo.SLOEngine(slo.SLOConfig(
+        objectives=[slo.SLOObjective(name, sli, objective,
+                                     threshold_s=threshold)],
+        **cfg_kw,
+    ))
+    return eng
+
+
+@pytest.fixture
+def fake_sli():
+    """Registers a controllable (good, total) source; yields the cell."""
+    cell = {"good": 0.0, "total": 0.0}
+    slo.register_sli_source("fake-sli", lambda obj: (cell["good"], cell["total"]))
+    yield cell
+    del slo.SLI_SOURCES["fake-sli"]
+
+
+class TestWindowMath:
+    def test_burn_rate_is_error_rate_over_budget(self, fake_sli):
+        """Hand-computed: objective 99.9% -> budget 0.1%. 1000 events,
+        10 bad in the 5m window -> error rate 0.01 -> burn 10x."""
+        eng = _engine(objective=0.999)
+        fake_sli.update(good=0.0, total=0.0)
+        eng.evaluate(now=0.0)
+        fake_sli.update(good=990.0, total=1000.0)
+        doc = eng.evaluate(now=60.0)
+        w = doc["objectives"][0]["windows"]["5m"]
+        assert w["goodDelta"] == 990.0 and w["totalDelta"] == 1000.0
+        assert w["errorRate"] == pytest.approx(0.01)
+        assert w["burnRate"] == pytest.approx(10.0)
+
+    def test_windows_cut_at_their_own_base(self, fake_sli):
+        """Samples across 2h: the 5m window sees only the newest delta,
+        the 1h window the last hour, the 6h/3d windows everything."""
+        eng = _engine(objective=0.99, eval_interval_s=1.0)
+        # t=0: 100 events, all good
+        fake_sli.update(good=100.0, total=100.0)
+        eng.evaluate(now=0.0)
+        # t=3600: +100 events, 50 bad (the 1h window's base)
+        fake_sli.update(good=150.0, total=200.0)
+        eng.evaluate(now=3600.0)
+        # t=6900 (exactly 5m before the final eval — the 5m base, since
+        # a window's base is the newest sample at least window_s old):
+        # +100 events, all good
+        fake_sli.update(good=250.0, total=300.0)
+        eng.evaluate(now=6900.0)
+        # t=7200: +10 events, 5 bad
+        fake_sli.update(good=255.0, total=310.0)
+        doc = eng.evaluate(now=7200.0)
+        w = doc["objectives"][0]["windows"]
+        # 5m: base is the t=6900 sample -> 10 events, 5 bad
+        assert w["5m"]["totalDelta"] == 10.0
+        assert w["5m"]["errorRate"] == pytest.approx(0.5)
+        # 1h: base is the t=3600 sample -> 110 events, 5 bad
+        assert w["1h"]["totalDelta"] == 110.0
+        assert w["1h"]["errorRate"] == pytest.approx(5 / 110)
+        # 6h: whole history -> 210 events (delta from first sample)
+        assert w["6h"]["totalDelta"] == 210.0
+        assert w["6h"]["errorRate"] == pytest.approx(55 / 210)
+
+    def test_zero_traffic_idles_at_zero_burn(self, fake_sli):
+        eng = _engine()
+        fake_sli.update(good=0.0, total=0.0)
+        doc = eng.evaluate(now=0.0)
+        obj = doc["objectives"][0]
+        assert all(w["burnRate"] == 0.0 for w in obj["windows"].values())
+        assert obj["budget"]["remainingRatio"] == 1.0
+        assert obj["burning"] == {"page": False, "ticket": False}
+
+
+class TestBudgetAccounting:
+    def test_budget_spend_hand_computed(self, fake_sli):
+        """objective 99% over 1000 events -> budget 10 bad events;
+        4 bad -> 40% spent, 60% remaining."""
+        eng = _engine(objective=0.99)
+        fake_sli.update(good=0.0, total=0.0)
+        eng.evaluate(now=0.0)
+        fake_sli.update(good=996.0, total=1000.0)
+        doc = eng.evaluate(now=100.0)
+        b = doc["objectives"][0]["budget"]
+        assert b["events"] == 1000.0
+        assert b["badEvents"] == 4.0
+        assert b["budgetEvents"] == pytest.approx(10.0)
+        assert b["remainingRatio"] == pytest.approx(0.6)
+        assert b["spentRatio"] == pytest.approx(0.4)
+        assert slo.slo_budget_remaining.value(slo="fake") == pytest.approx(0.6)
+
+    def test_budget_overspend_goes_negative(self, fake_sli):
+        eng = _engine(objective=0.99)
+        fake_sli.update(good=0.0, total=0.0)
+        eng.evaluate(now=0.0)
+        fake_sli.update(good=900.0, total=1000.0)  # 100 bad vs budget 10
+        doc = eng.evaluate(now=100.0)
+        assert doc["objectives"][0]["budget"]["remainingRatio"] == pytest.approx(-9.0)
+
+    def test_status_cumulative_bit_exact_with_raw_counters(self, fake_sli):
+        """The acceptance contract: /status/slo's cumulative pair equals
+        the raw SLI counters exactly (no reset -> adjusted == raw)."""
+        eng = _engine()
+        fake_sli.update(good=123.0, total=456.0)
+        doc = eng.evaluate(now=10.0)
+        cum = doc["objectives"][0]["cumulative"]
+        assert cum["rawGood"] == 123.0 and cum["rawTotal"] == 456.0
+        assert cum["good"] == 123.0 and cum["total"] == 456.0
+        assert slo.slo_events.value(slo="fake") == 456.0
+        assert slo.slo_good_events.value(slo="fake") == 123.0
+
+
+class TestCounterResetTolerance:
+    def test_reset_shifts_base_never_negative(self, fake_sli):
+        """A counter restart (raw drops to near zero) must fold the old
+        run into the monotone base, not produce negative deltas."""
+        eng = _engine(objective=0.99)
+        fake_sli.update(good=100.0, total=100.0)
+        eng.evaluate(now=0.0)
+        fake_sli.update(good=200.0, total=220.0)
+        eng.evaluate(now=60.0)
+        # process restart: counters back near zero, then 10 events 1 bad
+        fake_sli.update(good=9.0, total=10.0)
+        doc = eng.evaluate(now=120.0)
+        cum = doc["objectives"][0]["cumulative"]
+        # adjusted = old run (200/220) + new run (9/10)
+        assert cum["good"] == 209.0 and cum["total"] == 230.0
+        w = doc["objectives"][0]["windows"]["5m"]
+        assert w["totalDelta"] == 130.0  # 220->230 across the reset
+        assert w["goodDelta"] == 109.0
+        assert w["totalDelta"] >= 0 and w["goodDelta"] >= 0
+
+    def test_good_dip_clamps_never_folds(self, fake_sli):
+        """good is DERIVED (total - bad read at different instants), so
+        a transient dip while total grows is read skew, NOT a reset:
+        it must clamp — folding would inflate good past total and mask
+        every future error."""
+        eng = _engine(objective=0.99)
+        fake_sli.update(good=50.0, total=60.0)
+        eng.evaluate(now=0.0)
+        fake_sli.update(good=49.0, total=61.0)  # in-flight check failed
+        doc = eng.evaluate(now=60.0)
+        cum = doc["objectives"][0]["cumulative"]
+        assert cum["good"] == 50.0 and cum["total"] == 61.0  # clamped
+        # real errors AFTER the dip still burn (the masking regression)
+        fake_sli.update(good=50.0, total=161.0)  # +100 events, 99 bad
+        doc = eng.evaluate(now=120.0)
+        obj = doc["objectives"][0]
+        assert obj["windows"]["5m"]["errorRate"] > 0.9
+        assert obj["burning"]["page"] is True
+        # and skew can never push error rates negative
+        assert all(w["errorRate"] >= 0.0 for w in obj["windows"].values())
+
+    def test_status_caching_and_ring_coalescing(self, fake_sli):
+        """Request-driven status() must not sample faster than the eval
+        cadence (cached doc inside the interval), and near-coincident
+        direct evaluations coalesce instead of growing the ring."""
+        eng = _engine(eval_interval_s=15.0)
+        fake_sli.update(good=10.0, total=10.0)
+        doc1 = eng.status()
+        fake_sli.update(good=20.0, total=20.0)
+        doc2 = eng.status()  # inside the cadence: cached, not resampled
+        assert doc2["objectives"][0]["cumulative"]["rawTotal"] == 10.0
+        assert doc1["evaluatedAt"] == doc2["evaluatedAt"]
+        # direct evaluate() calls 1s apart coalesce into one sample
+        series = eng._series["fake"]
+        base_len = len(series.samples)
+        t0 = doc1["evaluatedAt"] + 100.0
+        for i in range(20):
+            eng.evaluate(now=t0 + i)
+        assert len(series.samples) <= base_len + 2
+
+
+class TestAlertConditions:
+    def test_fast_burn_requires_both_windows(self, fake_sli):
+        """The multi-window rule: a short spike trips 5m but not 1h ->
+        no page; sustained high burn trips both -> page."""
+        eng = _engine(objective=0.99, eval_interval_s=1.0)
+        # one hour of clean traffic first
+        fake_sli.update(good=100000.0, total=100000.0)
+        eng.evaluate(now=0.0)
+        fake_sli.update(good=200000.0, total=200000.0)
+        eng.evaluate(now=3300.0)  # exactly 5m before the eval: the 5m base
+        # spike: 100 events, 50 bad, inside the last 5m only
+        fake_sli.update(good=200050.0, total=200100.0)
+        doc = eng.evaluate(now=3600.0)
+        obj = doc["objectives"][0]
+        assert obj["windows"]["5m"]["burnRate"] > 14.4
+        assert obj["windows"]["1h"]["burnRate"] < 14.4  # diluted by clean hour
+        assert obj["burning"]["page"] is False
+        # sustained: the same ratio held over a fresh engine's whole
+        # history trips both fast windows
+        eng2 = _engine(objective=0.99)
+        fake_sli.update(good=0.0, total=0.0)
+        eng2.evaluate(now=0.0)
+        fake_sli.update(good=50.0, total=100.0)
+        doc2 = eng2.evaluate(now=60.0)
+        assert doc2["objectives"][0]["burning"]["page"] is True
+
+    def test_slow_burn_ticket(self, fake_sli):
+        """Slow pair: 6h burn > 6 AND 3d burn > 1."""
+        eng = _engine(objective=0.99)
+        fake_sli.update(good=0.0, total=0.0)
+        eng.evaluate(now=0.0)
+        # error rate 0.08 -> burn 8: over 6 (6h) and over 1 (3d)
+        fake_sli.update(good=920.0, total=1000.0)
+        doc = eng.evaluate(now=1000.0)
+        obj = doc["objectives"][0]
+        assert obj["burning"]["ticket"] is True
+        assert slo.slo_burning.value(slo="fake", severity="ticket") == 1.0
+
+    def test_unknown_sli_is_reported_not_fatal(self):
+        eng = slo.SLOEngine(slo.SLOConfig(
+            objectives=[slo.SLOObjective("ghost", "no-such-sli")]))
+        doc = eng.evaluate(now=0.0)
+        assert "unknown SLI source" in doc["objectives"][0]["error"]
+
+
+class TestBuiltinSLIs:
+    def test_route_availability_classification(self):
+        """5xx burns, 2xx/4xx don't; write vs read routes split by
+        method+route."""
+        c = metrics.REGISTRY.counter("tempo_request_duration_seconds_total")
+        base_w = slo._sli_availability_write(slo.SLOObjective("w", "availability_write"))
+        base_r = slo._sli_availability_read(slo.SLOObjective("r", "availability_read"))
+        c.inc(10, method="POST", route="/v1/traces", status_code="200")
+        c.inc(2, method="POST", route="/v1/traces", status_code="500")
+        c.inc(3, method="POST", route="/v1/traces", status_code="429")  # shed != bad
+        c.inc(5, method="GET", route="/api/search", status_code="200")
+        c.inc(1, method="GET", route="/api/search", status_code="503")
+        c.inc(4, method="GET", route="/api/traces/{traceID}", status_code="404")
+        good_w, total_w = slo._sli_availability_write(slo.SLOObjective("w", "availability_write"))
+        good_r, total_r = slo._sli_availability_read(slo.SLOObjective("r", "availability_read"))
+        assert (total_w - base_w[1], (total_w - good_w) - (base_w[1] - base_w[0])) == (15, 2)
+        assert (total_r - base_r[1], (total_r - good_r) - (base_r[1] - base_r[0])) == (10, 1)
+
+    def test_freshness_histogram_threshold(self):
+        from tempo_tpu.vulture import vulture_freshness
+
+        obj = slo.SLOObjective("f", "freshness", threshold_s=10.0)
+        g0, t0 = slo._sli_freshness(obj)
+        vulture_freshness.observe(0.5, tier="fresh")
+        vulture_freshness.observe(9.9, tier="recent")
+        vulture_freshness.observe(25.0, tier="recent")  # over budget
+        g1, t1 = slo._sli_freshness(obj)
+        assert t1 - t0 == 3
+        assert g1 - g0 == 2
+
+    def test_missing_family_yields_idle_sli(self):
+        assert slo._counter_sum("tempo_tpu_no_such_family") == 0.0
+        assert slo._hist_good_total("tempo_tpu_no_such_hist", 1.0) == (0.0, 0.0)
+        # the lookup must NOT have registered the family
+        assert metrics.REGISTRY.get("tempo_tpu_no_such_family") is None
+
+
+class TestStatusEndpointAndConfig:
+    def test_status_slo_served_and_app_wiring(self, tmp_path):
+        from tempo_tpu.api.server import TempoServer
+        from tempo_tpu.app import App, AppConfig
+        from tempo_tpu.db import DBConfig
+
+        cfg = AppConfig(
+            db=DBConfig(backend="local", backend_path=str(tmp_path / "b"),
+                        wal_path=str(tmp_path / "w")),
+            generator_enabled=False,
+        )
+        cfg.slo.enabled = True
+        app = App(cfg)
+        srv = TempoServer(app).start()
+        try:
+            import json
+
+            with urllib.request.urlopen(srv.url + "/status/slo") as r:
+                doc = json.loads(r.read())
+            assert doc["enabled"] is True
+            names = {o["name"] for o in doc["objectives"]}
+            # default objectives when none configured
+            assert "writes-available" in names and "vulture-read" in names
+            # gauges exported
+            with urllib.request.urlopen(srv.url + "/metrics") as r:
+                text = r.read().decode()
+            assert "tempo_tpu_slo_burn_rate" in text
+        finally:
+            srv.stop()
+            app.shutdown()
+
+    def test_status_slo_disabled(self, tmp_path):
+        from tempo_tpu.api.server import TempoServer
+        from tempo_tpu.app import App, AppConfig
+        from tempo_tpu.db import DBConfig
+
+        app = App(AppConfig(
+            db=DBConfig(backend="local", backend_path=str(tmp_path / "b"),
+                        wal_path=str(tmp_path / "w")),
+            generator_enabled=False,
+        ))
+        srv = TempoServer(app).start()
+        try:
+            import json
+
+            with urllib.request.urlopen(srv.url + "/status/slo") as r:
+                assert json.loads(r.read()) == {"enabled": False}
+        finally:
+            srv.stop()
+            app.shutdown()
+
+    def test_config_parse_and_warnings(self):
+        from tempo_tpu.config import check_config, parse_config
+
+        cfg = parse_config("""
+slo:
+  enabled: true
+  eval_interval_s: 5
+  objectives:
+    - {name: my-writes, sli: availability_write, objective: 0.999}
+    - {name: ghost, sli: nonexistent, objective: 0.99}
+    - {name: vr, sli: vulture, objective: 0.999}
+    - {name: bad-target, sli: availability_read, objective: 1.5}
+""")
+        assert cfg.app.slo.enabled and cfg.app.slo.eval_interval_s == 5
+        assert [o.name for o in cfg.app.slo.objectives] == [
+            "my-writes", "ghost", "vr", "bad-target"]
+        warns = "\n".join(check_config(cfg))
+        assert "unknown SLI source 'nonexistent'" in warns
+        assert "no vulture runs in this process" in warns
+        assert "outside (0, 1)" in warns
+
+    def test_vulture_config_warnings(self):
+        from tempo_tpu.config import check_config, parse_config
+
+        cfg = parse_config("""
+vulture:
+  enabled: true
+  aged_min_age_s: 60
+  retention_s: 50
+  write_backoff_s: 120
+  recent_min_age_s: 30
+""")
+        warns = "\n".join(check_config(cfg))
+        assert "outlive a compaction cycle" in warns
+        assert "aged tier window is empty" in warns
+        assert "no fresh-tier probe" in warns
+
+    def test_shipped_defaults_warn_free(self):
+        from tempo_tpu.config import check_config, parse_config
+
+        cfg = parse_config("""
+vulture:
+  enabled: true
+slo:
+  enabled: true
+""")
+        assert check_config(cfg) == []

@@ -1,16 +1,28 @@
-"""Vulture blackbox-checker tests.
+"""Vulture blackbox-checker tests: the continuous-verification plane.
 
 Reference pattern: the vulture runs against a real deployment; here it
 runs in-process against the all-in-one App and over real HTTP against
 TempoServer (the reference's continuous prod check, compressed into a
-deterministic test)."""
+deterministic test). The chaos class drives it under a seeded
+TEMPO_TPU_FAULTS plan (PR 6) and asserts every injected failure class
+is attributed to the right `type` and storage `tier` — and that a
+fault-free soak produces zero false positives.
+"""
 
 import pytest
 
 from tempo_tpu.app import App, AppConfig
 from tempo_tpu.db import DBConfig
+from tempo_tpu.modules.ingester import IngesterConfig
 from tempo_tpu.util.traceinfo import TraceInfo
-from tempo_tpu.vulture import HTTPClient, InProcessClient, Vulture, vulture_errors
+from tempo_tpu.vulture import (
+    HTTPClient,
+    InProcessClient,
+    Vulture,
+    VultureConfig,
+    vulture_errors,
+    vulture_freshness,
+)
 
 
 @pytest.fixture
@@ -48,6 +60,19 @@ class TestTraceInfo:
         assert not info.ready(1700000010, 10, 30)  # too fresh
         assert not TraceInfo(1700000003).ready(1700000100, 10, 30)  # off-cadence
 
+    def test_vulture_attribute_stamped(self):
+        info = TraceInfo(1700000000, "acme")
+        for s in info.construct_trace().all_spans():
+            assert s.attributes["vulture"] == "1700000000"
+        assert info.traceql_query() == '{ .vulture = "1700000000" }'
+
+    def test_expected_series_matches_span_starts(self):
+        info = TraceInfo(1700000000, "acme")
+        exp = info.expected_series(1700000000 - 5, 5)
+        assert sum(exp.values()) == info.span_count()
+        # spans start within [ts, ts+2): all bins inside the probe range
+        assert all(1700000000 - 5 <= ts < 1700000000 + 10 for ts in exp)
+
 
 class TestVultureInProcess:
     def test_write_then_check_ok(self, app):
@@ -59,12 +84,74 @@ class TestVultureInProcess:
         assert v.check_search(now, min_age_s=0)
         assert info.trace_id() == TraceInfo(now, v.tenant).trace_id()
 
+    def test_traceql_and_metrics_checks_ok(self, app):
+        """TraceQL + query_range readback: real `now` so the frontend
+        schedules the recent-window jobs (live/WAL inclusion keys off
+        wall clock), probe flushed so the block path is covered too."""
+        import time as _time
+
+        v = Vulture(InProcessClient(app), write_backoff_s=10)
+        now = int(_time.time())
+        info = v.write_once(now)
+        app.sweep_all(immediate=True)
+        app.db.poll_now()
+        assert v.check_traceql(now, tier="fresh", info=info)
+        assert v.check_metrics(now, tier="fresh", info=info)
+        assert v.check_counts[("metrics", "fresh")] == 1
+        assert sum(v.error_counts.values()) == 0
+
+    def test_run_checks_once_all_green(self, app):
+        """Full pass: no false positives on a healthy store, and tiers
+        with no eligible probe are skipped (None), never failed."""
+        import time as _time
+
+        v = Vulture(InProcessClient(app),
+                    cfg=VultureConfig(write_backoff_s=10, read_backoff_s=0))
+        now = int(_time.time()) - int(_time.time()) % 10
+        v.write_once(now)
+        app.sweep_all(immediate=True)
+        app.db.poll_now()
+        results = v.run_checks_once(now)
+        assert all(r is not False for r in results.values()), results
+        # only fresh has a probe: the single write just happened
+        assert {t for (_c, t), r in results.items() if r is True} == {"fresh"}
+        assert sum(v.error_counts.values()) == 0
+
     def test_detects_missing_trace(self, app):
         v = Vulture(InProcessClient(app), write_backoff_s=10)
-        base = vulture_errors.value(error_type="notfound_byid")
+        # simulate a previous incarnation's write history so the
+        # restart guard does not skip the probe
+        v.first_write_s = 1690000000
+        base = vulture_errors.total(type="notfound_byid")
         # nothing was ever written for this timestamp
         assert not v.check_by_id(1690000000, min_age_s=0)
-        assert vulture_errors.value(error_type="notfound_byid") == base + 1
+        assert vulture_errors.total(type="notfound_byid") == base + 1
+        assert v.error_counts[("notfound_byid", "fresh")] == 1
+
+    def test_restart_guard_skips_prehistory(self, app):
+        """A freshly started vulture must NOT page about timestamps it
+        never wrote (reference: the vulture bounds reads by its own
+        start time)."""
+        v = Vulture(InProcessClient(app), write_backoff_s=10)
+        assert v.check_by_id(1690000000, min_age_s=0)  # skipped, not failed
+        v.write_once(1700000000)
+        # timestamps before the first write still skip
+        assert v.check_by_id(1699999990, min_age_s=10)
+        assert sum(v.error_counts.values()) == 0
+
+    def test_skipped_cadence_slots_never_checked(self, app):
+        """A writer blocked past its cadence (slow freshness poll, push
+        retry) skips slots; the checker must pick from what was ACTUALLY
+        written, not fabricate the skipped slot and page notfound."""
+        v = Vulture(InProcessClient(app), write_backoff_s=10)
+        now = 1700000000
+        v.write_once(now - 40)  # then the writer stalled: 3 slots skipped
+        app.sweep_all(immediate=True)
+        # min_age 10 would fabricate now-10 (never written) on the old
+        # aligned path; the written-slot pick finds now-40 and passes
+        assert v.check_by_id(now, min_age_s=10)
+        assert v.check_counts[("byid", "fresh")] == 1
+        assert sum(v.error_counts.values()) == 0
 
     def test_detects_missing_spans(self, app):
         v = Vulture(InProcessClient(app), write_backoff_s=10)
@@ -77,14 +164,145 @@ class TestVultureInProcess:
         for r, s in full.batches[1:]:
             mutilated.batches.append((r, s))
         app.push_traces([mutilated])
-        base = vulture_errors.value(error_type="missing_spans")
+        v.first_write_s = now
+        base = vulture_errors.total(type="missing_spans")
         assert not v.check_by_id(now, min_age_s=0)
-        assert vulture_errors.value(error_type="missing_spans") == base + 1
+        assert vulture_errors.total(type="missing_spans") == base + 1
+
+    def test_detects_incorrect_result(self, app):
+        """All span IDs present but one span's content differs from the
+        deterministic construction -> incorrect_result, not missing."""
+        v = Vulture(InProcessClient(app), write_backoff_s=10)
+        now = 1700000000
+        info = TraceInfo(now, v.tenant)
+        full = info.construct_trace()
+        resource, spans = full.batches[0]
+        spans[0].name = "mangled-by-compaction"
+        app.push_traces([full])
+        v.first_write_s = now
+        base = vulture_errors.total(type="incorrect_result")
+        assert not v.check_by_id(now, min_age_s=0)
+        assert vulture_errors.total(type="incorrect_result") == base + 1
+
+    def test_detects_metrics_mismatch(self, app):
+        """query_range readback: a probe whose stored spans differ from
+        the expected per-bin series flags metrics_mismatch."""
+        import time as _time
+
+        v = Vulture(InProcessClient(app), write_backoff_s=10)
+        now = int(_time.time()) - int(_time.time()) % 10
+        info = TraceInfo(now, v.tenant)
+        full = info.construct_trace()
+        resource, spans = full.batches[0]
+        mutilated = type(full)(trace_id=full.trace_id, batches=[(resource, spans[:-1])])
+        for r, s in full.batches[1:]:
+            mutilated.batches.append((r, s))
+        app.push_traces([mutilated])
+        app.sweep_all(immediate=True)
+        app.db.poll_now()
+        v.first_write_s = now
+        base = vulture_errors.total(type="metrics_mismatch")
+        assert not v.check_metrics(now, tier="fresh", info=info)
+        assert vulture_errors.total(type="metrics_mismatch") == base + 1
 
     def test_outside_retention_skipped(self, app):
         v = Vulture(InProcessClient(app), write_backoff_s=10, retention_s=100)
+        v.first_write_s = 0
         # readable window is empty: min_age pushes past retention
         assert v.check_by_id(1700000000, min_age_s=200)
+
+    def test_tier_windows_and_age_mapping(self, app):
+        cfg = VultureConfig(write_backoff_s=10, recent_min_age_s=60,
+                            aged_min_age_s=600, retention_s=3600)
+        v = Vulture(InProcessClient(app), cfg=cfg)
+        assert v.tier_of_age(5) == "fresh"
+        assert v.tier_of_age(60) == "recent"
+        assert v.tier_of_age(599) == "recent"
+        assert v.tier_of_age(600) == "aged"
+        wins = v.tier_windows()
+        assert wins["fresh"] == (0, 60)
+        assert wins["recent"] == (60, 600)
+        assert wins["aged"] == (600, 3600)
+
+    def test_tiered_pass_checks_every_tier(self, app):
+        """Probes written across the tier age spectrum: one pass checks
+        each tier against ITS newest eligible probe."""
+        cfg = VultureConfig(write_backoff_s=10, read_backoff_s=0,
+                            recent_min_age_s=60, aged_min_age_s=600,
+                            retention_s=3600)
+        v = Vulture(InProcessClient(app), cfg=cfg)
+        now = 1700000000
+        # each tier's pick is the NEWEST cadence slot inside its window:
+        # now-0 (fresh), now-60 (recent), now-600 (aged)
+        for age in (0, 60, 600):
+            v.write_once(now - age)
+        app.sweep_all(immediate=True)
+        app.db.poll_now()  # blocks visible to the query path
+        results = v.run_checks_once(now, checks=("byid", "search"))
+        assert results[("byid", "fresh")] is True
+        assert results[("byid", "recent")] is True
+        assert results[("byid", "aged")] is True
+        assert sum(v.error_counts.values()) == 0
+        # check accounting: 2 checks x 3 tiers
+        assert sum(v.check_counts.values()) >= 6
+
+    def test_freshness_measurement_and_breach(self, app):
+        """Freshness needs real wall-clock probes: search visibility of
+        live (unflushed) data keys off the recent window, which the
+        frontend computes from real time."""
+        import time as _time
+
+        v = Vulture(InProcessClient(app),
+                    cfg=VultureConfig(write_backoff_s=10, freshness_slo_s=30.0))
+        now = int(_time.time()) - int(_time.time()) % 10
+        info = v.write_once(now)
+        base_f = vulture_freshness.count(tier="fresh")
+        lags = v.measure_freshness(info)
+        assert set(lags) == {"fresh", "recent"}
+        # in-process visibility is immediate: well under the budget
+        assert lags["fresh"] < 30.0 and lags["recent"] < 30.0
+        assert vulture_freshness.count(tier="fresh") == base_f + 1
+        assert v.error_counts.get(("freshness_breach", "fresh"), 0) == 0
+        # an impossible budget breaches deterministically
+        v2 = Vulture(InProcessClient(app),
+                     cfg=VultureConfig(write_backoff_s=20, freshness_slo_s=0.0))
+        info2 = v2.write_once(now)
+        v2.measure_freshness(info2)
+        assert v2.error_counts[("freshness_breach", "fresh")] == 1
+
+    def test_failed_check_carries_traceparent(self, app, caplog):
+        """One failed check = one traceable record: with a tracer armed,
+        the failure log line carries the probe span's traceparent."""
+        import logging
+
+        from tempo_tpu.util import tracing
+
+        captured = []
+        tracing.install_exporter(lambda traces: captured.extend(traces))
+        try:
+            v = Vulture(InProcessClient(app), write_backoff_s=10)
+            v.first_write_s = 1690000000
+            with caplog.at_level(logging.WARNING, logger="tempo_tpu.vulture"):
+                assert not v.check_by_id(1690000000, min_age_s=0)
+        finally:
+            tracing.uninstall_exporter()
+        line = next(r.message for r in caplog.records
+                    if "vulture check failed" in r.message)
+        assert "traceparent" in line
+        # the span itself was exported and is marked failed
+        spans = [s for t in captured for s in t.all_spans()
+                 if s.name == "vulture/check_byid"]
+        assert spans and spans[0].attributes.get("vulture.failed") == "notfound_byid"
+
+    def test_verify_written_audit(self, app):
+        v = Vulture(InProcessClient(app), write_backoff_s=10)
+        now = 1700000000
+        v.write_once(now - 20)
+        v.write_once(now)
+        app.sweep_all(immediate=True)
+        out = v.verify_written(now)
+        assert out["verified"] == 2
+        assert out["failures"] == {}
 
 
 class TestVultureHTTP:
@@ -99,8 +317,237 @@ class TestVultureHTTP:
             app.sweep_all(immediate=True)
             assert v.check_by_id(now, min_age_s=0)
             assert v.check_search(now, min_age_s=0)
-            base = vulture_errors.value(error_type="notfound_byid")
-            assert not v.check_by_id(1690000000, min_age_s=0)
-            assert vulture_errors.value(error_type="notfound_byid") == base + 1
+            assert v.check_traceql(now, tier="fresh")
+            assert v.check_metrics(now, tier="fresh")
+            base = vulture_errors.total(type="notfound_byid")
+            # audit a prior incarnation's never-written probe explicitly
+            assert not v.check_by_id(
+                1690000000, tier="fresh",
+                info=TraceInfo(1690000000, v.tenant))
+            assert vulture_errors.total(type="notfound_byid") == base + 1
         finally:
             srv.stop()
+
+
+class TestVultureRole:
+    def test_vulture_role_builds_sidecar(self, app, tmp_path):
+        """`-target=vulture` builds a process whose vulture drives the
+        cluster over HTTP; its own server serves /metrics."""
+        import urllib.request
+
+        from tempo_tpu.api.server import TempoServer
+
+        srv = TempoServer(app).start()
+        side = None
+        side_srv = None
+        try:
+            cfg = AppConfig(target="vulture")
+            cfg.vulture = VultureConfig(enabled=True, target=srv.url,
+                                        write_backoff_s=10)
+            side = App(cfg)
+            assert side.vulture is not None
+            now = 1700000000
+            side.vulture.write_once(now)
+            assert side.vulture.check_by_id(now, min_age_s=0)
+            side_srv = TempoServer(side).start()
+            with urllib.request.urlopen(side_srv.url + "/metrics") as r:
+                text = r.read().decode()
+            assert "tempo_vulture_trace_total" in text
+            states = side.service_states()
+            assert states["vulture"] == "Running"
+        finally:
+            if side_srv is not None:
+                side_srv.stop()
+            if side is not None:
+                side.shutdown()
+            srv.stop()
+
+    def test_vulture_role_requires_target(self):
+        cfg = AppConfig(target="vulture")
+        with pytest.raises(ValueError, match="vulture.target"):
+            App(cfg)
+
+    def test_in_process_vulture_multitenant(self, tmp_path):
+        """With multitenancy on, the in-process prober must carry its
+        org id — an org-less client would 401 every probe and page
+        TempoTpuVultureFailures on a healthy cluster."""
+        cfg = AppConfig(
+            db=DBConfig(backend="local", backend_path=str(tmp_path / "b"),
+                        wal_path=str(tmp_path / "w")),
+            generator_enabled=False,
+            multitenancy_enabled=True,
+        )
+        cfg.vulture = VultureConfig(enabled=True, tenant="probe-tenant",
+                                    write_backoff_s=10)
+        a = App(cfg)
+        try:
+            info = a.vulture.write_once(1700000000)
+            assert a.vulture.check_by_id(1700000000, info=info, tier="fresh")
+            assert sum(a.vulture.error_counts.values()) == 0
+        finally:
+            a.shutdown()
+
+    def test_in_process_vulture_on_all(self, tmp_path):
+        cfg = AppConfig(
+            db=DBConfig(backend="local", backend_path=str(tmp_path / "b"),
+                        wal_path=str(tmp_path / "w")),
+            generator_enabled=False,
+        )
+        cfg.vulture = VultureConfig(enabled=True, write_backoff_s=10)
+        a = App(cfg)
+        try:
+            assert a.vulture is not None
+            info = a.vulture.write_once(1700000000)
+            assert a.vulture.check_by_id(1700000000, info=info, tier="fresh")
+        finally:
+            a.shutdown()
+
+
+class TestVultureChaos:
+    """Closed-loop verification under a seeded fault plan (PR 6): each
+    injected failure class must surface as the right `type` on the
+    right `tier`, and healing the plan must stop the errors."""
+
+    @pytest.fixture
+    def chaos_app(self, tmp_path, monkeypatch):
+        # arm the PR 6 operator knob with a benign seeded plan: the
+        # backend is wrapped at build time, then the test escalates by
+        # swapping plans on the shared FaultInjectingBackend (the
+        # chaos-suite heal/escalate idiom)
+        monkeypatch.setenv("TEMPO_TPU_FAULTS", "seed=7")
+        cfg = AppConfig(
+            db=DBConfig(
+                backend="local",
+                backend_path=str(tmp_path / "blocks"),
+                wal_path=str(tmp_path / "wal"),
+            ),
+            # flushed blocks leave the ingester immediately, so reads
+            # MUST hit the (faulted) backend
+            ingester=IngesterConfig(complete_block_timeout_s=0.0),
+            generator_enabled=False,
+        )
+        a = App(cfg)
+        from tempo_tpu.backend.faults import FaultInjectingBackend
+
+        assert isinstance(a.db.backend.raw, FaultInjectingBackend)
+        yield a, a.db.backend.raw
+        a.shutdown()
+
+    def _written_and_flushed(self, app, v, now):
+        info = v.write_once(now)
+        app.sweep_all(immediate=True)
+        app.db.poll_now()
+        return info
+
+    def test_notfound_attributed_to_tier(self, chaos_app):
+        from tempo_tpu.backend.faults import FaultPlan
+
+        app, fb = chaos_app
+        cfg = VultureConfig(write_backoff_s=10, recent_min_age_s=60,
+                            aged_min_age_s=600, retention_s=3600)
+        v = Vulture(InProcessClient(app), cfg=cfg)
+        now = 1700000000
+        info = self._written_and_flushed(app, v, now - 120)  # recent tier
+        # escalate: every backend read flaps NotFound -> the flushed
+        # block is unreadable; the ingester no longer serves it
+        fb.plan = FaultPlan(seed=7, notfound_rate=1.0)
+        assert not v.check_by_id(now, tier="recent", info=info)
+        fb.plan = FaultPlan(seed=7)  # heal
+        assert v.check_by_id(now, tier="recent", info=info)
+        assert v.error_counts[("notfound_byid", "recent")] == 1
+        assert ("notfound_byid", "fresh") not in v.error_counts
+
+    def test_sustained_io_errors_quarantine_to_notfound(self, chaos_app):
+        """Every backend op failing: the PR 6 quarantine plane pulls the
+        unreadable block out of the view, so the vulture sees (and
+        correctly reports) NOTFOUND on the recent tier — data
+        unavailability, attributed to the tier whose block went dark."""
+        from tempo_tpu.backend.faults import FaultPlan
+
+        app, fb = chaos_app
+        v = Vulture(InProcessClient(app),
+                    cfg=VultureConfig(write_backoff_s=10, recent_min_age_s=60,
+                                      aged_min_age_s=600, retention_s=3600))
+        now = 1700000000
+        info = self._written_and_flushed(app, v, now - 120)
+        fb.plan = FaultPlan(seed=7, error_rates={"all": 1.0})
+        assert not v.check_by_id(now, tier="recent", info=info)
+        fb.plan = FaultPlan(seed=7)
+        assert v.error_counts[("notfound_byid", "recent")] >= 1
+
+    def test_request_failed_on_unreachable_endpoint(self, chaos_app):
+        """The transport class: the query endpoint itself erroring is
+        request_failed (network/serving problem, not storage)."""
+        app, _fb = chaos_app
+        v = Vulture(InProcessClient(app),
+                    cfg=VultureConfig(write_backoff_s=10, recent_min_age_s=60,
+                                      aged_min_age_s=600, retention_s=3600))
+        now = 1700000000
+        info = self._written_and_flushed(app, v, now - 120)
+
+        def down(_tid):
+            raise ConnectionError("injected: endpoint unreachable")
+
+        v.client.query = down
+        assert not v.check_by_id(now, tier="recent", info=info)
+        assert v.error_counts[("request_failed", "recent")] == 1
+
+    def test_fault_free_soak_zero_false_positives(self, chaos_app):
+        """With the seeded plan armed but all rates zero, a soak of
+        write->flush->verify cycles across tiers yields ZERO errors."""
+        app, fb = chaos_app
+        cfg = VultureConfig(write_backoff_s=10, read_backoff_s=0,
+                            recent_min_age_s=60, aged_min_age_s=600,
+                            retention_s=3600)
+        v = Vulture(InProcessClient(app), cfg=cfg)
+        now = 1700000000
+        for age in (900, 600, 120, 60, 0):
+            v.write_once(now - age)
+        app.sweep_all(immediate=True)
+        app.db.poll_now()
+        for _ in range(3):  # soak: repeated full passes
+            results = v.run_checks_once(now)
+            assert all(r is not False for r in results.values()), results
+        audit = v.verify_written(now)
+        assert audit["verified"] == 5 and audit["failures"] == {}
+        assert sum(v.error_counts.values()) == 0
+
+    def test_burn_rate_alert_fires_on_vulture_failures(self, chaos_app):
+        """Acceptance loop: injected faults -> vulture errors -> the
+        vulture-read SLI burns -> the fast-window (5m+1h) multi-window
+        condition fires; healing + fresh good checks cool it down."""
+        from tempo_tpu.backend.faults import FaultPlan
+        from tempo_tpu.util import slo as slo_mod
+
+        app, fb = chaos_app
+        v = Vulture(InProcessClient(app),
+                    cfg=VultureConfig(write_backoff_s=10, recent_min_age_s=60,
+                                      aged_min_age_s=600, retention_s=3600))
+        now = 1700000000
+        info = self._written_and_flushed(app, v, now - 120)
+
+        eng = slo_mod.SLOEngine(slo_mod.SLOConfig(
+            objectives=[slo_mod.SLOObjective("vulture-read", "vulture", 0.99)],
+        ))
+        t0 = 1000.0
+        eng.evaluate(now=t0)  # baseline sample before the faults
+        fb.plan = FaultPlan(seed=7, notfound_rate=1.0)
+        for _ in range(10):
+            v.check_by_id(now, tier="recent", info=info)
+        doc = eng.evaluate(now=t0 + 60)
+        obj = doc["objectives"][0]
+        # 10 bad / 10 checks in-window: error rate 1.0 / budget 0.001
+        assert obj["windows"]["5m"]["burnRate"] > 14.4
+        assert obj["windows"]["1h"]["burnRate"] > 14.4
+        assert obj["burning"]["page"] is True
+        assert eng.burning("vulture-read", "page")
+        from tempo_tpu.util.slo import slo_burning
+
+        assert slo_burning.value(slo="vulture-read", severity="page") == 1.0
+        # heal: good checks dilute the fast window back under threshold
+        fb.plan = FaultPlan(seed=7)
+        for _ in range(200):
+            assert v.check_by_id(now, tier="recent", info=info)
+        doc = eng.evaluate(now=t0 + 120)
+        obj = doc["objectives"][0]
+        assert obj["windows"]["5m"]["burnRate"] < 14.4

@@ -6,6 +6,12 @@
 #
 # Exit code: pytest's own (nonzero on any F/E, including collection
 # errors). The DOTS_PASSED line mirrors the driver's pass-count metric.
+#
+# Deeper (non-tier-1) gates when touching the ingest/query/SLO planes:
+#   python tools/loadtest.py --duration 120 --rate 10 --vulture
+# runs the mixed 10-100x workload WITH the continuous-verification
+# prober beside it and additionally gates on vulture correctness at
+# drain (zero notfound/incorrect probes) and the freshness SLO.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 

@@ -21,7 +21,11 @@ a machine-checkable gate:
   queryable after the drain,
 - bounded RSS: per-process RSS is sampled through the run and the
   final-quarter mean must not exceed `--rss-growth-limit` times the
-  second-quarter mean (monotonic growth under sustained load = leak).
+  second-quarter mean (monotonic growth under sustained load = leak),
+- `--vulture`: the continuous-verification prober (tempo_tpu/vulture.py)
+  runs beside the workload over real HTTP and the run gates on
+  read-after-write correctness at drain (zero notfound / missing /
+  incorrect probes) plus the write->searchable freshness SLO.
 
 Exit code is nonzero on any gate breach, so CI can use the rig as-is.
 
@@ -738,6 +742,75 @@ def storage_summary(query_url: str) -> dict:
     }
 
 
+def start_vulture(write_url: str, query_url: str, tenant: str | None):
+    """--vulture arm: the continuous-verification prober runs BESIDE the
+    mixed workload over real HTTP (writes via the distributor, reads via
+    the frontend — the sidecar deployment shape), on a compressed tier
+    clock so a two-minute run still exercises fresh AND recent tiers."""
+    from tempo_tpu.vulture import HTTPClient, Vulture, VultureConfig
+
+    cfg = VultureConfig(
+        tenant=tenant or "single-tenant",
+        write_backoff_s=2,
+        # checks only pick probes >= read_backoff old: under 10-100x
+        # load write->readable lag runs seconds, and checking younger
+        # probes would just re-measure freshness as phantom notfounds
+        read_backoff_s=5,
+        search_backoff_s=4,
+        metrics_backoff_s=10,
+        recent_min_age_s=8,
+        aged_min_age_s=30,
+        retention_s=600,
+        freshness_slo_s=10.0,
+        metrics_step_s=5,
+    )
+    client = HTTPClient(write_url, tenant=tenant, query_url=query_url)
+    v = Vulture(client, cfg=cfg)
+    v.start()
+    return v
+
+
+def vulture_summary(v, freshness_slo_s: float = 10.0,
+                    settle_s: float = 15.0) -> dict:
+    """Stop the prober, run the drain-time audit, and gate:
+    - zero notfound/missing/incorrect at drain (every probe the cluster
+      acked under load must be fully readable once ingest settles),
+    - the freshness SLI: p99 write->searchable lag within the SLO.
+    The audit polls until clean or settle_s elapses: a probe written
+    moments before the stop may still be flushing — a visibility race
+    heals across passes, real loss persists."""
+    v.stop()
+    deadline = time.time() + settle_s
+    while True:
+        drain = v.verify_written()
+        if not drain["failures"] or time.time() >= deadline:
+            break
+        time.sleep(2.0)
+    errors_by_type: dict = {}
+    for (type_, tier), n in sorted(v.error_counts.items()):
+        errors_by_type[f"{type_}:{tier}"] = n
+    lags = sorted(lag for _tier, lag in v.freshness_lags)
+    p99 = lags[min(len(lags) - 1, int(len(lags) * 0.99))] if lags else 0.0
+    correctness_classes = ("notfound_byid", "notfound_search",
+                           "missing_spans", "incorrect_result",
+                           "metrics_mismatch")
+    drain_bad = sum(drain["failures"].get(c, 0) for c in correctness_classes)
+    freshness_ok = not lags or p99 <= freshness_slo_s
+    return {
+        "writes": len(v.written),
+        "checks": sum(v.check_counts.values()),
+        "errors": errors_by_type,
+        "drain": drain,
+        "freshness_p99_s": round(p99, 3),
+        "freshness_samples": len(lags),
+        "gates": {
+            "drain_correctness": drain_bad == 0,
+            "freshness_slo": freshness_ok,
+        },
+        "passed": bool(drain_bad == 0 and freshness_ok),
+    }
+
+
 class RSSSampler:
     """Samples each cluster process's RSS once a second; the gate rejects
     monotonic growth (final-quarter mean vs second-quarter mean)."""
@@ -809,6 +882,10 @@ def main() -> int:
     ap.add_argument("--query-range", action="store_true",
                     help="probe /api/metrics/query_range after the load "
                          "and gate on matrix responses")
+    ap.add_argument("--vulture", action="store_true",
+                    help="run the continuous-verification prober beside "
+                         "the mixed workload and gate on read-after-write "
+                         "correctness at drain + the freshness SLO")
     ap.add_argument("--tenants", type=int, default=1,
                     help=">1 enables multi-tenant mode: the cluster boots "
                          "with multitenancy, every op carries one of N org "
@@ -852,6 +929,12 @@ def main() -> int:
         sweep_ok = all(v in ("ok", "skipped") for v in sweep.values()) if sweep else True
 
         rss = RSSSampler(procs).start() if procs else None
+        vulture = None
+        if args.vulture:
+            vulture = start_vulture(write_url, query_url,
+                                    tenant_ids[0] if tenant_ids else None)
+            print("[loadtest] vulture prober running beside the workload",
+                  file=sys.stderr)
         slo = {op: (p99 * args.slo_scale, err) for op, (p99, err) in DEFAULT_SLO.items()}
         summary, acked_ids = run_mixed_load(
             write_url, query_url, duration_s=args.duration, rate=args.rate,
@@ -863,6 +946,12 @@ def main() -> int:
         loss = verify_acked(query_url, acked_ids)
         summary["acked_loss"] = loss
         print(f"[loadtest] acked-loss check: {loss}", file=sys.stderr)
+
+        vulture_ok = True
+        if vulture is not None:
+            summary["vulture"] = vulture_summary(vulture)
+            vulture_ok = summary["vulture"]["passed"]
+            print(f"[loadtest] vulture gate: {summary['vulture']}", file=sys.stderr)
 
         if rss is not None:
             summary["rss"] = rss.stop_and_summary(args.rss_growth_limit)
@@ -888,6 +977,7 @@ def main() -> int:
             and loss["passed"]
             and sweep_ok
             and attribution_ok
+            and vulture_ok
             and (rss is None or summary["rss"]["passed"])
         )
         print(json.dumps(summary))
