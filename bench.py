@@ -481,6 +481,95 @@ def _metrics_rep(reps: int = 3) -> dict:
         tmp.cleanup()
 
 
+def _graph_rep(reps: int = 3) -> dict:
+    """Trace-graph rep (BENCH_r06+): service-dependency aggregation +
+    critical paths over seeded stored blocks with REAL parent chains
+    (synth.make_graph_batch), host vs device critical-path arms on
+    identical data. Parity is asserted (the two-limb device accumulation
+    must equal host uint64 bit-for-bit); the JSON line carries edges/s
+    for the dependencies pass and spans/s for the critical-path arms."""
+    from tempo_tpu import graph
+    from tempo_tpu.backend import LocalBackend, TypedBackend
+    from tempo_tpu.encoding import from_version
+    from tempo_tpu.encoding.common import BlockConfig
+    from tempo_tpu.encoding.vtpu.colcache import shared_cache
+    from tempo_tpu.model import synth
+
+    enc = from_version("vtpu1")
+    tmp = tempfile.TemporaryDirectory(dir=_bench_dir())
+    try:
+        backend = TypedBackend(LocalBackend(tmp.name))
+        cfg = BlockConfig(row_group_spans=2048)
+        metas = [
+            enc.create_block(
+                [synth.make_graph_batch(2048, 8, seed=900 + j)], "bench",
+                backend, cfg)
+            for j in range(6)
+        ]
+        total_spans = sum(m.total_spans for m in metas)
+
+        def run_once(want: str, device: bool):
+            cache = shared_cache()
+            if cache is not None:
+                cache.clear()  # every run pays its own IO
+            wire = graph.new_deps_wire() if want == "deps" else graph.new_cp_wire()
+            merge = graph.merge_deps_wire if want == "deps" else graph.merge_cp_wire
+            for m in metas:
+                blk = enc.open_block(m, backend, cfg)
+                rows = graph.collect_block_rows(blk, None)
+                sub = (graph.new_deps_wire() if want == "deps"
+                       else graph.new_cp_wire())
+                if rows is not None:
+                    if want == "deps":
+                        graph.deps_partial(rows, blk.dictionary(), wire=sub)
+                    else:
+                        graph.cp_partial(rows, blk.dictionary(), device=device,
+                                         bucket_for=cfg.bucket_for, wire=sub)
+                merge(wire, sub)
+            return wire
+
+        run_once("deps", False)  # warmup: page cache
+        run_once("cp", True)     # warmup: jit compile
+        t_deps, t_host, t_dev = [], [], []
+        deps_wire = cp_host = cp_dev = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            deps_wire = run_once("deps", False)
+            t_deps.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            cp_host = run_once("cp", False)
+            t_host.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            cp_dev = run_once("cp", True)
+            t_dev.append(time.perf_counter() - t0)
+        edge_instances = sum(e["count"] for e in deps_wire["edges"].values())
+        deps_s = float(np.median(t_deps))
+        host_s = float(np.median(t_host))
+        dev_s = float(np.median(t_dev))
+        return {
+            "blocks": len(metas),
+            "spans": int(total_spans),
+            "deps": {
+                "s": round(deps_s, 4),
+                "edges": len(deps_wire["edges"]),
+                "edge_instances": int(edge_instances),
+                "edges_per_s": round(edge_instances / deps_s, 1),
+                "unpaired": int(deps_wire["unpaired"]),
+            },
+            "critical_path": {
+                "host_s": round(host_s, 4),
+                "device_s": round(dev_s, 4),
+                "paired_host_over_device": round(float(np.median(
+                    [h / d for h, d in zip(t_host, t_dev)])), 3),
+                "spans_per_s_host": round(total_spans / host_s, 1),
+                "spans_per_s_device": round(total_spans / dev_s, 1),
+                "parity": bool(cp_host == cp_dev),
+            },
+        }
+    finally:
+        tmp.cleanup()
+
+
 def _decode_rep(reps: int = 5) -> dict:
     """Per-codec decode throughput (MB/s of DECODED payload): the host
     entropy tier (zstd_shuffle via the native lib, zlib fallback) vs the
@@ -905,6 +994,12 @@ def _run(dog, partial: dict):
     decode_rep = _decode_rep()
     partial["decode"] = decode_rep
 
+    # trace-graph analytics: dependencies + critical path, host vs
+    # device critical-path arms (ISSUE 13 tentpole)
+    graph_rep = _graph_rep()
+    partial["graph"] = graph_rep
+    print(f"[bench] graph: {graph_rep}", file=sys.stderr)
+
     med, spread = _stats(tpu_times)
     blocks_per_s = B_BLOCKS / med
     # paired per-rep ratios: epoch noise hits both arms of a pair, so the
@@ -949,6 +1044,7 @@ def _run(dog, partial: dict):
         "search": search_rep,
         "metrics": metrics_rep,
         "decode": decode_rep,
+        "graph": graph_rep,
     }))
 
 

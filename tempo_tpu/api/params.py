@@ -23,6 +23,10 @@ PATH_SEARCH_TAGS = "/api/search/tags"
 PATH_SEARCH_TAG_VALUES = "/api/search/tag"  # + /{name}/values
 PATH_METRICS_QUERY_RANGE = "/api/metrics/query_range"
 PATH_USAGE = "/api/usage"  # tenant-scoped cost rollup
+# trace-graph analytics plane (tempo_tpu/graph)
+PATH_GRAPH_DEPENDENCIES = "/api/graph/dependencies"
+PATH_GRAPH_CRITICAL_PATH = "/api/graph/critical-path"
+PATH_GRAPH_WALKS = "/api/graph/walks"
 PATH_QUERY_INSIGHTS = "/api/query-insights"  # tenant-scoped query records
 PATH_ECHO = "/api/echo"
 
@@ -294,6 +298,46 @@ def parse_query_range_request(qs: dict, now_s: int | None = None) -> QueryRangeR
         raise BadRequest("maxSeries must be positive")
     if req.exemplars < 0:
         raise BadRequest("exemplars must be non-negative")
+    return req
+
+
+@dataclass
+class GraphRequest:
+    query: str = ""
+    start_s: int = 0
+    end_s: int = 0
+    by: str = "service"  # critical-path attribution: service | name
+    # walk sampler knobs (graph/walks.py)
+    walks: int = 32
+    steps: int = 6
+    seed: int = 0
+    window_s: int = 0
+    start_node: str | None = None
+
+
+def parse_graph_request(qs: dict) -> GraphRequest:
+    """Params of the /api/graph/* endpoints: optional TraceQL spanset
+    filter `q` selecting the root set, optional start/end (unix s), and
+    the critical-path/walk knobs. An empty q means every trace in range."""
+    req = GraphRequest()
+    req.query = _first(qs, "q") or _first(qs, "query")
+    req.start_s, req.end_s, _ = parse_time_range(
+        _first(qs, "start", "0"), _first(qs, "end", "0"))
+    req.by = _first(qs, "by", "service")
+    try:
+        req.walks = int(_first(qs, "walks", "32"))
+        req.steps = int(_first(qs, "steps", "6"))
+        req.seed = int(_first(qs, "seed", "0"))
+        req.window_s = int(_first(qs, "window", "0"))
+    except ValueError as e:
+        raise BadRequest(str(e)) from None
+    if req.walks < 0 or req.walks > 4096:
+        raise BadRequest("walks must be in [0, 4096]")
+    if req.steps < 1 or req.steps > 256:
+        raise BadRequest("steps must be in [1, 256]")
+    if req.window_s < 0:
+        raise BadRequest("window must be non-negative")
+    req.start_node = _first(qs, "from") or None
     return req
 
 

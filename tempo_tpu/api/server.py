@@ -17,6 +17,9 @@ Routes:
   GET  /api/search/tags      tag names in recent data
   GET  /api/search/tag/{n}/values
   GET  /api/metrics/query_range   TraceQL metrics (Prometheus matrix)
+  GET  /api/graph/dependencies    stored-block service graph
+  GET  /api/graph/critical-path   per-trace longest self-time paths
+  GET  /api/graph/walks           seeded temporal random walks
   GET  /api/echo             frontend liveness ("echo")
   GET  /ready /metrics /status[/config|/services|/endpoints|/buildinfo]
 """
@@ -333,6 +336,10 @@ class _Handler(BaseHTTPRequestHandler):
             return self._search(qs)
         if path == api_params.PATH_METRICS_QUERY_RANGE:
             return self._query_range(qs)
+        if path in (api_params.PATH_GRAPH_DEPENDENCIES,
+                    api_params.PATH_GRAPH_CRITICAL_PATH,
+                    api_params.PATH_GRAPH_WALKS):
+            return self._graph(path, qs)
         if path == api_params.PATH_SEARCH_TAGS:
             self._send_json(200, {"tagNames": app.search_tags(org_id=self._org_id())})
             return 200
@@ -627,6 +634,37 @@ class _Handler(BaseHTTPRequestHandler):
         })
         return 200
 
+    def _graph(self, path: str, qs: dict) -> int:
+        """Trace-graph analytics (tempo_tpu/graph): stored-block service
+        dependencies, device critical paths, and seeded temporal random
+        walks, with a TraceQL spanset filter selecting the root set."""
+        req = api_params.parse_graph_request(qs)
+        org = self._org_id()
+        t0 = time.monotonic()
+        try:
+            if path == api_params.PATH_GRAPH_DEPENDENCIES:
+                doc = self.app.graph_dependencies(
+                    req.query, req.start_s, req.end_s, org_id=org)
+            elif path == api_params.PATH_GRAPH_CRITICAL_PATH:
+                doc = self.app.graph_critical_path(
+                    req.query, req.start_s, req.end_s, by=req.by, org_id=org)
+            else:
+                doc = self.app.graph_walks(
+                    req.query, req.start_s, req.end_s, org_id=org,
+                    walks=req.walks, steps=req.steps, seed=req.seed,
+                    window_s=req.window_s, start_node=req.start_node)
+        except ValueError as e:
+            # the graph plane's contract (same as search/query_range):
+            # ValueError = unsupported root filter / window / admission
+            # guidance, a client error end to end
+            raise BadRequest(str(e)) from e
+        stats = doc.setdefault("stats", {})
+        stats["elapsedMs"] = int((time.monotonic() - t0) * 1000)
+        for k in ("inspectedBytes", "decodedBytes"):
+            stats[k] = str(stats.get(k, 0))
+        self._send_json(200, doc)
+        return 200
+
     def _search(self, qs: dict) -> int:
         req = api_params.parse_search_request(qs)
         org = self._org_id()
@@ -697,6 +735,9 @@ _ENDPOINTS = [
     "GET /api/search/tags",
     "GET /api/search/tag/{name}/values",
     "GET /api/metrics/query_range",
+    "GET /api/graph/dependencies",
+    "GET /api/graph/critical-path",
+    "GET /api/graph/walks",
     "GET /api/usage",
     "GET /api/query-insights",
     "GET /api/echo",

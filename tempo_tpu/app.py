@@ -529,6 +529,29 @@ class App:
             max_series=max_series, exemplars=exemplars,
         )
 
+    def graph_dependencies(self, q: str = "", start_s: int = 0, end_s: int = 0,
+                           org_id=None) -> dict:
+        """Stored-block service-dependency graph over a TraceQL-selected
+        root set (the live generator's edges, but over months of blocks)."""
+        return self._require(self.frontend, "queries").graph_dependencies(
+            self.resolve_tenant(org_id), q, start_s, end_s
+        )
+
+    def graph_critical_path(self, q: str = "", start_s: int = 0, end_s: int = 0,
+                            by: str = "service", org_id=None) -> dict:
+        """Per-trace longest self-time paths, attributed by service or
+        span name — "where does p99 actually go" over any spanset."""
+        return self._require(self.frontend, "queries").graph_critical_path(
+            self.resolve_tenant(org_id), q, start_s, end_s, by=by
+        )
+
+    def graph_walks(self, q: str = "", start_s: int = 0, end_s: int = 0,
+                    org_id=None, **kw) -> dict:
+        """Seeded temporal random walks over the aggregated service graph."""
+        return self._require(self.frontend, "queries").graph_walks(
+            self.resolve_tenant(org_id), q, start_s, end_s, **kw
+        )
+
     def search_tags(self, org_id=None) -> list[str]:
         """Reference: /api/search/tags is proxied by the frontend straight
         to queriers (no sharding middleware)."""

@@ -362,6 +362,81 @@ def cmd_analyse_blocks(args) -> int:
     return 0
 
 
+# -- graph -----------------------------------------------------------------
+
+
+def _graph_wire(args, want: str):
+    """Offline trace-graph aggregation straight off stored blocks (no
+    running cluster): the same per-block partials the graph_* worker
+    jobs compute, merged locally."""
+    from tempo_tpu import encoding as encoding_registry
+    from tempo_tpu import graph
+
+    be = _backend(args)
+    metas, _ = _tenant_metas(be, args.tenant)
+    pipeline = graph.parse_root_filter(args.q)
+    by = getattr(args, "by", "service")
+    wire = graph.new_deps_wire() if want == "deps" else graph.new_cp_wire(by)
+    merge = graph.merge_deps_wire if want == "deps" else graph.merge_cp_wire
+    for m in sorted(metas, key=lambda m: str(m.block_id)):
+        if args.start and m.end_time < args.start:
+            continue
+        if args.end and m.start_time > args.end:
+            continue
+        blk = encoding_registry.from_version(m.version).open_block(m, be)
+        stats = {"inspectedBlocks": 1}
+        rows = graph.collect_block_rows(blk, pipeline, args.start, args.end,
+                                        stats=stats)
+        sub = graph.new_deps_wire() if want == "deps" else graph.new_cp_wire(by)
+        if rows is not None:
+            if want == "deps":
+                graph.deps_partial(rows, blk.dictionary(), wire=sub)
+            else:
+                graph.cp_partial(rows, blk.dictionary(), by=by, wire=sub,
+                                 device=False)
+        stats["inspectedBytes"] = blk.bytes_read
+        sub["stats"] = {**sub["stats"], **stats}
+        merge(wire, sub)
+    return wire
+
+
+def cmd_graph_dependencies(args) -> int:
+    """Service-dependency edges aggregated offline from stored blocks
+    (the /api/graph/dependencies result without a cluster)."""
+    from tempo_tpu import graph
+
+    doc = graph.finalize_deps(_graph_wire(args, "deps"))
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return 0
+    rows = [
+        [e["client"], e["server"], e["count"], e["failed"],
+         f"{e['errorRate']:.1%}", e["p50Ms"], e["p99Ms"]]
+        for e in doc["edges"]
+    ]
+    _print_table(rows, ["client", "server", "count", "failed", "err%",
+                        "p50ms", "p99ms"])
+    print(f"\nunpaired spans: {doc['unpairedSpans']}  "
+          f"blocks: {doc['stats'].get('inspectedBlocks', 0)}")
+    return 0
+
+
+def cmd_graph_critical_path(args) -> int:
+    """Per-service/name critical-path seconds aggregated offline."""
+    from tempo_tpu import graph
+
+    doc = graph.finalize_cp(_graph_wire(args, "cp"))
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return 0
+    rows = [[g["name"], f"{g['seconds']:.3f}", g["spans"], f"{g['share']:.1%}"]
+            for g in doc["groups"]]
+    _print_table(rows, [doc["by"], "seconds", "spans", "share"])
+    print(f"\ntraces: {doc['traces']}  total: {doc['totalSeconds']:.3f}s  "
+          f"path p50/p99: {doc['pathP50Ms']}/{doc['pathP99Ms']} ms")
+    return 0
+
+
 # -- vulture ---------------------------------------------------------------
 
 
@@ -579,6 +654,21 @@ def build_parser() -> argparse.ArgumentParser:
     abs_.add_argument("--window-s", type=int, default=3600,
                       help="compaction window for the debt sweep")
     abs_.set_defaults(fn=cmd_analyse_blocks)
+
+    gr = sub.add_parser(
+        "graph", help="trace-graph analytics over stored blocks (offline)"
+    ).add_subparsers(dest="what", required=True)
+    for gname, gfn in (("dependencies", cmd_graph_dependencies),
+                       ("critical-path", cmd_graph_critical_path)):
+        gp = gr.add_parser(gname)
+        gp.add_argument("tenant")
+        gp.add_argument("--q", default="", help="TraceQL spanset filter (root set)")
+        gp.add_argument("--start", type=int, default=0, help="unix seconds")
+        gp.add_argument("--end", type=int, default=0)
+        gp.add_argument("--json", action="store_true")
+        if gname == "critical-path":
+            gp.add_argument("--by", choices=("service", "name"), default="service")
+        gp.set_defaults(fn=gfn)
 
     vc = sub.add_parser(
         "vulture-check",

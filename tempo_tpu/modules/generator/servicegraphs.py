@@ -12,19 +12,27 @@ BASELINE.json north-star metric for this processor.
 
 from __future__ import annotations
 
-import hashlib
 import time
 
 import numpy as np
 
 import jax.numpy as jnp
 
+# edge semantics (pairing rule, failure classification, sketch key) are
+# shared with the stored-block trace-graph engine (tempo_tpu/graph) so
+# live-generator edges and /api/graph/dependencies cannot drift
+from tempo_tpu.graph import edge_hash_limbs, span_failed
 from tempo_tpu.model.trace import KIND_CLIENT, KIND_SERVER
 from tempo_tpu.ops import sketch
 
 REQ_TOTAL = "traces_service_graph_request_total"
 REQ_FAILED = "traces_service_graph_request_failed_total"
 REQ_SECONDS = "traces_service_graph_request_server_seconds"
+# spans evicted from the pairing store without ever matching, labeled by
+# which half waited (store="client"|"server") and why it left
+# (reason="expired"|"evicted") — so stored-vs-live graph discrepancies
+# are attributable instead of a single opaque int
+EXPIRED_TOTAL = "traces_service_graph_expired_spans_total"
 
 DEFAULT_BOUNDS = [0.1, 0.2, 0.4, 0.8, 1.6, 3.2, 6.4, 12.8]
 
@@ -66,7 +74,7 @@ class ServiceGraphsProcessor:
             else:
                 key = (tid, c["parent_span_id"][row].tobytes())
                 dur_s = float(c["duration_nano"][row]) / 1e9
-                failed = int(c["status_code"][row]) == 2
+                failed = span_failed(int(c["status_code"][row]))
                 cli = self.pending_clients.pop(key, None)
                 if cli is not None:
                     self._emit(cli[0], svc, dur_s, failed)
@@ -78,8 +86,15 @@ class ServiceGraphsProcessor:
     def _put(self, store, key, value):
         if len(store) >= self.max_items:
             store.pop(next(iter(store)), None)  # evict oldest-inserted
-            self.expired += 1
+            self._count_unpaired(store, "evicted")
         store[key] = value
+
+    def _count_unpaired(self, store, reason: str) -> None:
+        self.expired += 1
+        half = "client" if store is self.pending_clients else "server"
+        self.registry.inc_counter(
+            EXPIRED_TOTAL, (("store", half), ("reason", reason)), 1.0
+        )
 
     def _emit(self, client_svc: str, server_svc: str, dur_s: float, failed: bool):
         if client_svc == server_svc:
@@ -93,13 +108,10 @@ class ServiceGraphsProcessor:
         counts[bidx] = 1
         self.registry.observe_histogram(REQ_SECONDS, labels, self.bounds, counts, dur_s, 1)
         self.edges_emitted += 1
-        # sketch update batched in _flush_sketches; hash the full pair so
-        # long client names don't truncate away the server half of the key
-        digest = hashlib.blake2s(
-            (client_svc + "\x00" + server_svc).encode(), digest_size=16
-        ).digest()
-        h = np.frombuffer(digest, dtype=">u4").astype(np.uint32)
-        self._edge_keys.append(h)
+        # sketch update batched in _flush_sketches; the key hash is the
+        # shared graph-module definition (full pair, so long client names
+        # don't truncate away the server half)
+        self._edge_keys.append(edge_hash_limbs(client_svc, server_svc))
 
     def _flush_sketches(self):
         if not self._edge_keys:
@@ -114,7 +126,7 @@ class ServiceGraphsProcessor:
             dead = [k for k, v in store.items() if now - v[ts_idx] > self.wait_s]
             for k in dead:
                 del store[k]
-                self.expired += 1
+                self._count_unpaired(store, "expired")
 
     def distinct_edges_estimate(self) -> float:
         return float(sketch.hll_estimate(self.hll, sketch.HLLPlan(12)))

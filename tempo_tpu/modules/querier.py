@@ -260,6 +260,80 @@ class Querier:
                 have.extend(ex[: max(0, plan.exemplars - len(have))])
         return acc.to_wire()
 
+    # ------------------------------------------------------------------
+    # trace-graph analytics (service dependencies / critical paths)
+    # ------------------------------------------------------------------
+    def graph_recent(self, tenant: str, q: str, start_s: int, end_s: int,
+                     want: str, by: str = "service") -> dict:
+        """Graph partials over not-yet-flushed ingester data (live trace
+        segments + WAL head blocks), the recent-window complement of
+        graph_blocks — same disjointness contract as search_recent."""
+        from tempo_tpu import graph
+
+        pipeline = graph.parse_root_filter(q)
+        wire = (graph.new_deps_wire() if want == "deps"
+                else graph.new_cp_wire(by))
+        for batch in self._live_batches(tenant):
+            rows = graph.batch_graph_rows(batch, pipeline, start_s, end_s)
+            if rows is None:
+                continue
+            if want == "deps":
+                graph.deps_partial(rows, batch.dictionary, wire=wire)
+            else:
+                graph.cp_partial(rows, batch.dictionary, by=by, wire=wire,
+                                 bucket_for=self.db.cfg.block.bucket_for)
+        return wire
+
+    def graph_blocks(self, tenant: str, block_ids: list, q: str, start_s: int,
+                     end_s: int, want: str, by: str = "service") -> dict:
+        """One frontend graph job = a batch of backend blocks. Each block
+        commits its partial only after evaluating WHOLE (the metrics-path
+        contract: integer partials have no dedupe, so a block deleted
+        mid-scan must contribute nothing — its spans live on in the
+        compaction output that replaced it)."""
+        from tempo_tpu import graph
+
+        pipeline = graph.parse_root_filter(q)
+        wire = (graph.new_deps_wire() if want == "deps"
+                else graph.new_cp_wire(by))
+        for bid in block_ids:
+            try:
+                meta = self.db.backend.block_meta(tenant, bid)
+            except NotFound:
+                log.warning("graph job: block %s deleted mid-query", bid)
+                continue
+
+            def run(meta=meta):
+                blk = self.db.encoding_for(meta.version).open_block(
+                    meta, self.db.backend, self.db.cfg.block)
+                stats = {"inspectedBlocks": 1}
+                rows = graph.collect_block_rows(
+                    blk, pipeline, start_s, end_s, stats=stats)
+                sub = (graph.new_deps_wire() if want == "deps"
+                       else graph.new_cp_wire(by))
+                if rows is not None:
+                    if want == "deps":
+                        graph.deps_partial(rows, blk.dictionary(), wire=sub)
+                    else:
+                        graph.cp_partial(rows, blk.dictionary(), by=by,
+                                         wire=sub,
+                                         bucket_for=self.db.cfg.block.bucket_for)
+                stats["inspectedBytes"] = blk.bytes_read
+                stats["decodedBytes"] = getattr(blk, "decoded_bytes", 0)
+                sub["stats"] = {**sub["stats"], **stats}
+                return sub
+
+            try:
+                sub = self.db.guard_block(tenant, bid, run)
+            except NotFound:
+                log.warning("graph job: block %s deleted mid-query", bid)
+                continue
+            if want == "deps":
+                graph.merge_deps_wire(wire, sub)
+            else:
+                graph.merge_cp_wire(wire, sub)
+        return wire
+
     def search_tags(self, tenant: str) -> list[str]:
         """Tag names in live ingester data AND backend blocks. The
         reference snapshot fans SearchTags to ingesters only

@@ -187,6 +187,13 @@ FAMILY_SERIES_BUDGETS = {
     "tempo_tpu_slo_burning": 32,
     # query-insights capture counter: kind x reason enums
     "tempo_tpu_query_insights_total": 32,
+    # trace-graph analytics plane: label-less totals + a small kind enum
+    # (dependencies | critical_path | walks) — edges/services must NEVER
+    # become labels here; per-edge data belongs in query responses
+    "tempo_tpu_graph_edges_total": 2,
+    "tempo_tpu_graph_unpaired_spans_total": 2,
+    "tempo_tpu_graph_walk_steps_total": 2,
+    "tempo_tpu_graph_queries_total": 8,
     # tenant x kind cost counters (usage accountant eviction bounds tenant)
     **{f"tempo_tpu_usage_{f}_total": 448 for f in (
         "ingested_bytes", "ingested_spans", "flushed_bytes",
