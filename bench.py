@@ -86,6 +86,24 @@ def _loadavg() -> float:
         return -1.0
 
 
+def _transfer_totals() -> tuple[float, float]:
+    """(h2d, d2h) untagged transfer-counter totals — reps snapshot these
+    around each arm so the JSON line carries per-arm transfer bytes
+    (BENCH_r06 fields; the device data-movement plane, ISSUE 14)."""
+    from tempo_tpu.util.devicetiming import transfer_bytes_total
+
+    return (transfer_bytes_total.total(direction="h2d"),
+            transfer_bytes_total.total(direction="d2h"))
+
+
+def _transfer_delta(before: tuple, per: int = 1) -> dict:
+    h2d, d2h = _transfer_totals()
+    return {
+        "h2d_bytes": int((h2d - before[0]) / max(per, 1)),
+        "d2h_bytes": int((d2h - before[1]) / max(per, 1)),
+    }
+
+
 def _bench_dir() -> str | None:
     """Prefer tmpfs: the VM's virtio disk writeback adds multi-second
     run-to-run swings that have nothing to do with the engine (all arms
@@ -281,11 +299,15 @@ def _search_rep(reps: int = 3) -> dict:
             if waterfall is not None:
                 wire = st.to_wire()
                 stage_s = wire["stageSeconds"]
-                host_s = sum(v for k, v in stage_s.items() if k != "kernel")
+                host_s = sum(v for k, v in stage_s.items()
+                             if k not in ("kernel", "transfer"))
                 waterfall.update({
                     "stage_seconds": stage_s,
                     "host_s": round(host_s, 6),
+                    # the transfer/kernel split (exclusive stages): what
+                    # the old all-in "kernel" wall conflated
                     "device_s": round(stage_s.get("kernel", 0.0), 6),
+                    "transfer_s": round(stage_s.get("transfer", 0.0), 6),
                     "device_dispatches": wire["deviceDispatches"],
                 })
             return out
@@ -303,10 +325,12 @@ def _search_rep(reps: int = 3) -> dict:
                 try:
                     run_once(req, ms, be)  # warm the page cache, not the column cache
                     times = []
+                    tx0 = _transfer_totals()
                     for _ in range(reps):
                         t0 = time.perf_counter()
                         resp = run_once(req, ms, be, waterfall=wf)
                         times.append(time.perf_counter() - t0)
+                    tx = _transfer_delta(tx0, per=reps)
                 finally:
                     for k in env:
                         os.environ.pop(k, None)
@@ -317,6 +341,7 @@ def _search_rep(reps: int = 3) -> dict:
                     "pruned_row_groups": resp.pruned_row_groups,
                     "coalesced_reads": resp.coalesced_reads,
                     "waterfall": wf,  # last rep's stage split
+                    "transfer": tx,  # per-rep device transfer bytes
                 }
                 hitsets[arm] = {t.trace_id_hex for t in resp.traces}
                 totals[arm]["s"] += arms[arm]["s"]
@@ -345,6 +370,8 @@ def _search_rep(reps: int = 3) -> dict:
                 "parity": parity,
                 # where the pruned arm's time goes (stage waterfall)
                 "waterfall": arms["pruned"]["waterfall"],
+                # per-rep device transfer bytes of the production arm
+                "transfer": arms["pruned"]["transfer"],
             }
         return {
             **per_query,
@@ -437,13 +464,27 @@ def _metrics_rep(reps: int = 3) -> dict:
             run_once(q, True, True)   # warmup: jit compiles + page cache
             run_once(q, False, True)
             t_dev, t_host = [], []
+            dev_tx0 = host_tx0 = None
+            dev_tx = host_tx = {"h2d_bytes": 0, "d2h_bytes": 0}
             for _ in range(reps):
+                tx0 = _transfer_totals()
                 t0 = time.perf_counter()
                 acc_dev = run_once(q, True, True)
                 t_dev.append(time.perf_counter() - t0)
+                dev_tx0 = tx0 if dev_tx0 is None else dev_tx0
+                tx0 = _transfer_totals()
                 t0 = time.perf_counter()
                 acc_host = run_once(q, False, True)
                 t_host.append(time.perf_counter() - t0)
+                host_tx = _transfer_delta(tx0)
+                # host-arm sanity: the numpy reduction never crosses the
+                # device boundary — any nonzero here means the transfer
+                # plane is mis-counting host work as movement
+                assert host_tx["h2d_bytes"] == 0 and host_tx["d2h_bytes"] == 0, (
+                    f"host metrics arm recorded device transfer: {host_tx}")
+            # device-arm transfer per rep (host reps ran between the
+            # device reps but were just asserted to contribute zero)
+            dev_tx = _transfer_delta(dev_tx0, per=reps)
             for arm, acc, times in (("device", acc_dev, t_dev),
                                     ("host", acc_host, t_host)):
                 arms[arm] = {"s": float(np.median(times)),
@@ -472,6 +513,10 @@ def _metrics_rep(reps: int = 3) -> dict:
                 "bytes_ratio": round(
                     unpruned.stats["inspectedBytes"] / max(arms["host"]["bytes"], 1), 3),
                 "parity": parity,
+                # per-rep device transfer bytes: device arm vs the
+                # asserted-zero host arm (ISSUE 14 / BENCH_r06 fields)
+                "transfer": dev_tx,
+                "host_transfer": host_tx,
             }
         r = out["rate"]
         out["pruning_ok"] = bool(r["inspected_bytes"] < r["inspected_bytes_unpruned"])
@@ -532,16 +577,27 @@ def _graph_rep(reps: int = 3) -> dict:
         run_once("cp", True)     # warmup: jit compile
         t_deps, t_host, t_dev = [], [], []
         deps_wire = cp_host = cp_dev = None
+        host_tx = {"h2d_bytes": 0, "d2h_bytes": 0}
+        dev_tx0 = None
         for _ in range(reps):
             t0 = time.perf_counter()
             deps_wire = run_once("deps", False)
             t_deps.append(time.perf_counter() - t0)
+            tx0 = _transfer_totals()
             t0 = time.perf_counter()
             cp_host = run_once("cp", False)
             t_host.append(time.perf_counter() - t0)
+            host_tx = _transfer_delta(tx0)
+            # host critical-path arm is pure numpy pointer doubling: any
+            # transfer bytes here are a transfer-plane accounting bug
+            assert host_tx["h2d_bytes"] == 0 and host_tx["d2h_bytes"] == 0, (
+                f"host graph arm recorded device transfer: {host_tx}")
+            if dev_tx0 is None:
+                dev_tx0 = _transfer_totals()
             t0 = time.perf_counter()
             cp_dev = run_once("cp", True)
             t_dev.append(time.perf_counter() - t0)
+        dev_tx = _transfer_delta(dev_tx0, per=reps)
         edge_instances = sum(e["count"] for e in deps_wire["edges"].values())
         deps_s = float(np.median(t_deps))
         host_s = float(np.median(t_host))
@@ -564,6 +620,9 @@ def _graph_rep(reps: int = 3) -> dict:
                 "spans_per_s_host": round(total_spans / host_s, 1),
                 "spans_per_s_device": round(total_spans / dev_s, 1),
                 "parity": bool(cp_host == cp_dev),
+                # per-rep device transfer bytes (host arm asserted zero)
+                "transfer": dev_tx,
+                "host_transfer": host_tx,
             },
         }
     finally:
@@ -616,9 +675,12 @@ def _decode_rep(reps: int = 5) -> dict:
                 arr.nbytes),
         }
         if codec == "dbp":
+            tx0 = _transfer_totals()
             row["device_mb_s"] = mb_s(
                 lambda: pk.dbp_decode_device(page, arr.dtype.str, arr.shape),
                 arr.nbytes)
+            # per-decode transfer: encoded words up, expanded limbs back
+            row["device_transfer"] = _transfer_delta(tx0, per=reps + 1)
         elif kind == "entropy":
             # the byte-unshuffle stage of zstd_shuffle on device: host
             # pays the entropy decode, the shifts+ors transpose lands

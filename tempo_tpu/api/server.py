@@ -532,6 +532,35 @@ class _Handler(BaseHTTPRequestHandler):
             refresh = qs.get("refresh", ["0"])[0] not in ("0", "", "false")
             self._send_json(200, scanner.report(max_age_s=0 if refresh else None))
             return 200
+        if path == "/status/device":
+            # device data-movement plane (util/pageheat + devicetiming):
+            # per-kernel transfer bytes, the (block, column) page-heat
+            # hot set with transfer amplification, and the ghost-LRU
+            # what-if curve — "pinning the top N MB of compressed pages
+            # in HBM would have eliminated X% of transfer bytes".
+            # ?budgets_mb=64,128,256 overrides the working-set-fraction
+            # budgets; ?top=N bounds the hot-set report.
+            from tempo_tpu.util import pageheat
+
+            budgets = None
+            raw = qs.get("budgets_mb", [""])[0]
+            if raw:
+                try:
+                    budgets = [int(float(b) * (1 << 20))
+                               for b in raw.split(",") if b.strip()]
+                except (ValueError, OverflowError) as e:
+                    # OverflowError: int(inf * 2**20) — same client error
+                    raise BadRequest(f"bad budgets_mb: {e}") from e
+                if not budgets or any(b <= 0 for b in budgets):
+                    raise BadRequest(
+                        f"bad budgets_mb {raw!r}: need positive MB values")
+            try:
+                top = int(qs.get("top", ["50"])[0])
+            except ValueError as e:
+                raise BadRequest(f"bad top: {e}") from e
+            self._send_json(200, pageheat.device_report(
+                budgets_bytes=budgets, top=top))
+            return 200
         if path == "/status/slo":
             # the burn-rate SLO engine's accounting document (util/slo):
             # per objective, the cumulative good/total the SLIs derive
@@ -750,6 +779,7 @@ _ENDPOINTS = [
     "GET /status/endpoints",
     "GET /status/profile",
     "GET /status/profile/device",
+    "GET /status/device",
     "GET /status/usage",
     "GET /status/usage-stats",
     "GET /status/slo",

@@ -146,6 +146,7 @@ class App:
         self.remote_write_storage = None
         self.usage_reporter = None
         self.storage_scanner = None
+        self.pageheat_exporter = None
         self.rpc = None
         self._heartbeat_stops = []
         self._registered: list = []  # (ring, instance_id) to unregister on shutdown
@@ -167,6 +168,7 @@ class App:
             self._build_role(target)
         self._maybe_self_tracing()
         self._maybe_storage_scanner()
+        self._maybe_pageheat_exporter()
         self._maybe_vulture()
         if cfg.slo.enabled:
             self.slo_engine = slo.SLOEngine(cfg.slo)
@@ -450,6 +452,19 @@ class App:
         self.storage_scanner = StorageScanner(
             self.db, interval_s=self.cfg.db.analytics_scan_s)
 
+    def _maybe_pageheat_exporter(self):
+        """Device data-movement export (util/pageheat): refresh the
+        per-budget miss-ratio gauges on an interval and, when
+        TEMPO_TPU_PAGEHEAT_EXPORT_DIR is set, write the ledger snapshot
+        `cli analyse device` replays. Runs wherever block reads happen —
+        any role that owns a storage engine (heat accrues in the
+        process doing the reads, unlike the fleet-wide storage scan)."""
+        if self.db is None:
+            return
+        from tempo_tpu.util.pageheat import PageHeatExporter
+
+        self.pageheat_exporter = PageHeatExporter()
+
     def _maybe_usage_reporter(self):
         cfg = self.cfg
         if cfg.usage_stats is not None and getattr(cfg.usage_stats, "enabled", False):
@@ -578,6 +593,8 @@ class App:
             self.usage_reporter.start_loop()
         if self.storage_scanner is not None:
             self.storage_scanner.start()
+        if self.pageheat_exporter is not None:
+            self.pageheat_exporter.start()
         if self.vulture is not None:
             self.vulture.start()
         if self.slo_engine is not None:
@@ -643,5 +660,7 @@ class App:
             self.usage_reporter.stop()
         if self.storage_scanner is not None:
             self.storage_scanner.stop()
+        if self.pageheat_exporter is not None:
+            self.pageheat_exporter.stop()
         if self.db is not None:
             self.db.shutdown()

@@ -362,6 +362,43 @@ def cmd_analyse_blocks(args) -> int:
     return 0
 
 
+def cmd_analyse_device(args) -> int:
+    """Offline device data-movement analysis over a page-heat ledger
+    snapshot (the periodic exporter's device_ledger.json): hot-set
+    report, transfer amplification, and the ghost-LRU what-if curve —
+    recomputed at --budgets-mb when given, since the snapshot carries
+    the raw access stream (the same answer /status/device serves live)."""
+    from tempo_tpu.util import pageheat
+
+    doc = pageheat.load_snapshot(args.snapshot)
+    budgets = [b for b in (args.budgets_mb or "").split(",") if b.strip()]
+    r = pageheat.analyse_snapshot(doc, budgets_mb=budgets or None)
+    if args.json:
+        print(json.dumps(r, indent=2))
+        return 0
+    heat = r["pageHeat"]
+    print(f"pages tracked: {heat.get('trackedPages', 0)}  "
+          f"ships: {heat.get('totalShips', 0)}  "
+          f"moved: {heat.get('totalMovedBytes', 0):,} bytes  "
+          f"amplification: {heat.get('amplification', 0)}x")
+    rows = [
+        [h["block"][:16], h["column"], h["ships"], f"{h['movedBytes']:,}",
+         f"{h['encodedBytes']:,}", f"{h['amplification']}x"]
+        for h in heat.get("hotSet", [])[: args.top]
+    ]
+    _print_table(rows, ["block", "column", "ships", "moved", "encoded", "amp"])
+    print("\nwhat-if HBM residency (ghost-LRU over the access stream):")
+    for c in r["whatIf"].get("curve", []):
+        print(f"  budget {c.get('budget', c['budgetBytes'])}"
+              f" ({c['budgetBytes']:,} B): miss {c['missRatio']:.1%}, "
+              f"eliminates {c['savedBytes']:,} transfer bytes "
+              f"({c['savedRatio']:.1%})")
+    for p in heat.get("pinning", [])[:4]:
+        print(f"  pin top {p['pages']} pages ({p['pinnedBytes']:,} B) -> "
+              f"saves {p['savedBytes']:,} B ({p['savedRatio']:.1%})")
+    return 0
+
+
 # -- graph -----------------------------------------------------------------
 
 
@@ -654,6 +691,19 @@ def build_parser() -> argparse.ArgumentParser:
     abs_.add_argument("--window-s", type=int, default=3600,
                       help="compaction window for the debt sweep")
     abs_.set_defaults(fn=cmd_analyse_blocks)
+    ad = an.add_parser(
+        "device",
+        help="device data-movement: page heat + what-if HBM residency "
+             "over an exported ledger snapshot")
+    ad.add_argument("snapshot", help="device_ledger.json written by the "
+                                     "page-heat exporter")
+    ad.add_argument("--budgets-mb", default="",
+                    help="comma-separated HBM budgets in MB to re-run the "
+                         "ghost-LRU simulation at (default: the snapshot's "
+                         "working-set-fraction curve)")
+    ad.add_argument("--top", type=int, default=20)
+    ad.add_argument("--json", action="store_true")
+    ad.set_defaults(fn=cmd_analyse_device)
 
     gr = sub.add_parser(
         "graph", help="trace-graph analytics over stored blocks (offline)"

@@ -194,9 +194,15 @@ class EncodedColumn:
         if cache is not None:
             values, lengths = cache.get(kv), cache.get(kl)
             if values is not None and lengths is not None:
+                # a warm hit is STILL a re-ship: the host cache elides
+                # IO+decode, not the h2d trip — exactly the signal the
+                # page-heat ledger exists to surface
+                blk._touch_pageheat(self.name, pm,
+                                    values.nbytes + lengths.nbytes)
                 return values, lengths
         values, lengths = lw.rle_decode_runs(self._page(), pm.dtype, pm.shape)
         blk._account_decoded(values.nbytes + lengths.nbytes)
+        blk._touch_pageheat(self.name, pm, values.nbytes + lengths.nbytes)
         if cache is not None:
             cache.put(kv, values)
             cache.put(kl, lengths)
@@ -212,12 +218,16 @@ class EncodedColumn:
         if cache is not None:
             values, idx = cache.get(kv), cache.get(ki)
             if values is not None and idx is not None:
+                w = max(values.shape[0] - 1, 0).bit_length()
+                blk._touch_pageheat(self.name, pm,
+                                    values.nbytes + (self.n * w + 7) // 8)
                 return values, idx
         values, idx = lw.dct_indices(self._page(), pm.dtype, pm.shape)
         # index expansion materializes no values: count the packed
         # stream's size (width bits per row), i.e. the encoded form
         w = max(values.shape[0] - 1, 0).bit_length()
         blk._account_decoded(values.nbytes + (self.n * w + 7) // 8)
+        blk._touch_pageheat(self.name, pm, values.nbytes + (self.n * w + 7) // 8)
         if cache is not None:
             cache.put(kv, values)
             cache.put(ki, idx)
@@ -413,6 +423,20 @@ class VtpuBackendBlock:
         usage.account_bytes(decoded_bytes_total, "decoded_bytes",
                             self.meta.tenant_id, nbytes)
 
+    def _touch_pageheat(self, name: str, pm, moved_bytes: int) -> None:
+        """Feed the device data-movement ledger (util/pageheat): one
+        query-path access to this (block, column, page), sized by what
+        would ship to the device (`moved_bytes`) vs the page's stored
+        size. Query paths only — one-shot streaming readers (compaction,
+        column_cache=None) would poison the heat signal with pages that
+        are about to be rewritten."""
+        if self._colcache is None:
+            return
+        from tempo_tpu.util import pageheat
+
+        pageheat.touch(self.meta.block_id, name, pm.offset,
+                       moved_bytes, pm.length)
+
     def _fetch_columns(self, rg: fmt.RowGroupMeta, names: list[str]) -> dict[str, np.ndarray]:
         """Fetch+decode columns with coalesced ranged reads, accounting
         the round trips saved vs one-read-per-page."""
@@ -481,6 +505,10 @@ class VtpuBackendBlock:
             for name, arr in dec.items():
                 cache.put((self.meta.block_id, name, rg.pages[name].offset), arr)
                 out[name] = arr
+        # page-heat ledger: hits AND misses are accesses — the host
+        # cache elides IO/decode, never the per-dispatch h2d trip
+        for name, arr in out.items():
+            self._touch_pageheat(name, rg.pages[name], arr.nbytes)
         return out
 
     def bloom_plan(self) -> bloom.BloomPlan:

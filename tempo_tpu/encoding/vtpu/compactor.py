@@ -56,6 +56,7 @@ from tempo_tpu.model.columnar import (
 )
 from tempo_tpu import native
 from tempo_tpu.ops import bloom, merge, sketch
+from tempo_tpu.util.devicetiming import count_transfer
 from tempo_tpu.util.pipeline import ReadAhead, overlap_enabled, prefetch_iter
 from tempo_tpu.util import tracing
 
@@ -744,6 +745,12 @@ class _ShardedTileMerger:
         # device payload plane (payload_plane="device") eliminates
         st["d2h_bytes"] += perm.nbytes + keep.nbytes
         st["per_shard_rows"] += n_valid
+        # process-wide transfer plane, at the SAME statements as the
+        # per-job stats (no blocking seam: the sketch accumulators stay
+        # on device across tiles by design)
+        count_transfer("mesh_compaction",
+                       h2d=t.nbytes + s.nbytes + v.nbytes,
+                       d2h=perm.nbytes + keep.nbytes)
 
         orders, keeps = [], []
         for shard in range(self.r):
@@ -773,6 +780,8 @@ class _ShardedTileMerger:
         import jax
 
         bloom_acc, hll_acc, cm_acc = jax.device_get(self._accs)
+        count_transfer("mesh_compaction", d2h=sum(
+            int(np.asarray(a).nbytes) for a in (bloom_acc, hll_acc, cm_acc)))
         bloom_words = np.bitwise_or.reduce(np.asarray(bloom_acc), axis=0)
         hll_regs = np.asarray(hll_acc).max(axis=0)
         cm_counts = np.asarray(cm_acc).sum(axis=0, dtype=np.uint32)
@@ -923,6 +932,8 @@ class _DevicePayloadTileMerger:
         # psum(bloom) + pmax(hll) + psum(cm) + psum(tile_comb) per tile
         st["collectives"] += 4
         st["h2d_bytes"] += sum(int(x.nbytes) for x in (t, s, v, lanes_sh))
+        count_transfer("payload_compaction",
+                       h2d=sum(int(x.nbytes) for x in (t, s, v, lanes_sh)))
 
     # ------------------------------------------------------------------
     def _alloc_buffers(self, cap: int) -> None:
@@ -983,6 +994,7 @@ class _DevicePayloadTileMerger:
         packed = np.asarray(pack_payload_flush(*self._bufs))
         self.stats["d2h_flushes"] += 1
         self.stats["d2h_bytes"] += packed.nbytes
+        count_transfer("payload_compaction", d2h=packed.nbytes)
 
         r, C, D, T = self.r, self.kept_cap, self.drop_cap, self.T_MAX
         o = 0
@@ -1103,6 +1115,8 @@ class _DevicePayloadTileMerger:
         import jax
 
         bloom_acc, hll_acc, cm_acc = jax.device_get(self._accs)
+        count_transfer("payload_compaction", d2h=sum(
+            int(np.asarray(a).nbytes) for a in (bloom_acc, hll_acc, cm_acc)))
         bloom_words = np.bitwise_or.reduce(np.asarray(bloom_acc), axis=0)
         hll_regs = np.asarray(hll_acc).max(axis=0)
         cm_counts = np.asarray(cm_acc).sum(axis=0, dtype=np.uint32)

@@ -137,18 +137,29 @@ class DeviceSketchAccumulator:
     def _flush(self) -> None:
         if not self._pending:
             return
+        from tempo_tpu.util.devicetiming import count_transfer
+
         ids = self._pending[0] if len(self._pending) == 1 else np.concatenate(self._pending)
         self._pending, self._n_pending = [], 0
         ids_p, valid = _pad_ids(ids, self._bucket(len(ids)))
         # async dispatch: no sync here — the donated accumulators stay on
-        # device and the host goes straight back to encoding columns
+        # device and the host goes straight back to encoding columns.
+        # Movement is accounted WITHOUT the blocking timed_dispatch seam
+        # (a per-flush block_until_ready would serialize exactly the
+        # overlap this accumulator exists for).
+        count_transfer("sketch_accumulate",
+                       h2d=ids_p.nbytes + valid.nbytes)
         self._words, self._regs = self._step(
             self._words, self._regs, jnp.asarray(ids_p), jnp.asarray(valid)
         )
 
     def finish(self) -> dict:
+        from tempo_tpu.util.devicetiming import count_transfer
+
         self._flush()
         packed = np.asarray(_accum_finish(self.hp)(self._words, self._regs))
+        # the one D2H sync of the whole accumulation
+        count_transfer("sketch_finish", d2h=packed.nbytes)
         words, est = _unpack_sketch(packed, self.plan)
         return {"bloom_plan": self.plan, "bloom_words": words, "est_distinct": est}
 
@@ -394,11 +405,16 @@ class BlockWriter:
             hp = sketch.HLLPlan(cfg.hll_precision)
             # the dispatch is async: the device builds sketches while the
             # host writes index + dictionary; then ONE fetch of the packed
-            # array pays a single tunnel round trip
+            # array pays a single tunnel round trip (bytes accounted to
+            # the transfer plane without a blocking sync)
+            from tempo_tpu.util.devicetiming import count_transfer
+
             out = _sketch_step(plan, hp)(jnp.asarray(ids_p), jnp.asarray(valid))
+            count_transfer("block_sketch", h2d=ids_p.nbytes + valid.nbytes)
             backend.write_named(meta, ColumnIndexName, self.index.to_bytes())
             backend.write_named(meta, DictionaryName, fmt.serialize_dictionary(self.dictionary))
             packed = np.asarray(out)
+            count_transfer("block_sketch", d2h=packed.nbytes)
             words, est = _unpack_sketch(packed, plan)
         for s in range(plan.n_shards):
             backend.write_named(meta, bloom_name(s), bloom.shard_to_bytes(words[s]))

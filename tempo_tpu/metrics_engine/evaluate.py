@@ -284,8 +284,13 @@ class DeviceAccumulator(HostAccumulator):
         raw = self._buf[0] if len(self._buf) == 1 else np.concatenate(self._buf)
         self._buf, self._buf_rows = [], 0
         slots, weights = compress_slot_runs(raw)
+        # ship=False: seg_bincount is a HOST wrapper (pads and picks the
+        # reduction home itself) — the seam sizes the slot/weight bytes
+        # as h2d without converting them, and the whole wall stays in
+        # the kernel stage exactly as before the transfer split
         self.counts += timed_dispatch(
-            "seg_bincount", seg_bincount, slots, self.plan.n_slots, weights=weights)
+            "seg_bincount", seg_bincount, slots, self.plan.n_slots,
+            ship=False, weights=weights)
         self.dispatches += 1
 
     def merged_counts(self) -> np.ndarray:

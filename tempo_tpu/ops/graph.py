@@ -138,9 +138,12 @@ def root_path_sums_device(parent: np.ndarray, self_ns: np.ndarray,
     p[:n] = parent.astype(np.int32)
     hi = (s >> np.uint64(32)).astype(np.uint32)
     lo = (s & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    # raw host arrays: the timed_dispatch seam ships them itself, so
+    # this kernel's h2d bytes + transfer time land in the device
+    # data-movement plane
     out_hi, out_lo = timed_dispatch(
         "graph_critical_path", _root_sums_limbs,
-        jnp.asarray(p), jnp.asarray(hi), jnp.asarray(lo),
+        p, hi, lo,
         rounds=_n_rounds(n),
     )
     out = (np.asarray(out_hi).astype(np.uint64) << np.uint64(32)) | np.asarray(out_lo)

@@ -417,6 +417,7 @@ def dbp_decode_device(page: bytes, dtype: str, shape: tuple) -> np.ndarray:
     lightweight.dbp_decode; the jit below is what the fused mesh scan
     inlines next to its predicate compare."""
     from tempo_tpu.encoding.vtpu import lightweight as lw
+    from tempo_tpu.util.devicetiming import timed_dispatch
 
     first, _anchors, widths, streams, n = lw.dbp_parts(page, dtype, shape)
     dt = np.dtype(dtype)
@@ -428,8 +429,12 @@ def dbp_decode_device(page: bytes, dtype: str, shape: tuple) -> np.ndarray:
         raw = bytes(streams[c])
         pad = (-len(raw)) % 4 + 4  # round to words + one guard word
         words = np.frombuffer(raw + b"\x00" * pad, "<u4")
-        hi, lo = _dbp_decode_jit(
-            jnp.asarray(words),
+        # the packed words go in raw: the dispatch seam ships them, so
+        # the decode kernel's h2d (the ENCODED size — the whole point of
+        # device decode) and d2h (the expanded limbs) are both measured
+        hi, lo = timed_dispatch(
+            "dbp_decode", _dbp_decode_jit,
+            words,
             jnp.uint32(first[c] >> np.uint64(32)),
             jnp.uint32(first[c] & np.uint64(0xFFFFFFFF)),
             jnp.int32(widths[c]),
@@ -499,11 +504,16 @@ def fused_rle_in_set(values: np.ndarray, lengths: np.ndarray,
                      codes: np.ndarray, n: int) -> np.ndarray:
     """Host wrapper for the fused batched scan (the single-device analog
     of parallel/search.make_sharded_rle_scan). Rows past a unit's true
-    span count must be masked by the caller's valid mask."""
-    return np.asarray(_fused_rle_in_set_jit(
-        jnp.asarray(values.astype(np.uint32)),
-        jnp.asarray(lengths.astype(np.int32)),
-        jnp.asarray(codes.astype(np.uint32)),
+    span count must be masked by the caller's valid mask. Runs under the
+    dispatch seam: the run-form h2d bytes vs the (U, n) mask d2h are
+    exactly the zero-decode economy the transfer plane exists to show."""
+    from tempo_tpu.util.devicetiming import timed_dispatch
+
+    return np.asarray(timed_dispatch(
+        "fused_rle_scan", _fused_rle_in_set_jit,
+        values.astype(np.uint32),
+        lengths.astype(np.int32),
+        codes.astype(np.uint32),
         n,
     ))
 

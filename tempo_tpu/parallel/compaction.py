@@ -20,6 +20,14 @@ The BASELINE.json north star. How it maps:
 A second optional "window" mesh axis runs independent compaction
 windows side by side (reference P5: windows are independent jobs), with
 no collectives crossing it.
+
+Data movement: the consumers of these factories (the tile mergers in
+encoding/vtpu/compactor.py) keep their accumulators device-resident
+across tiles, so they must NOT block per dispatch — they account their
+h2d/d2h bytes into the device data-movement plane via
+util/devicetiming.count_transfer at the same statements that update
+their per-job stats, instead of the blocking timed_dispatch seam the
+query-path kernels use.
 """
 
 from __future__ import annotations

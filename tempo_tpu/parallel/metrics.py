@@ -125,10 +125,12 @@ class MeshMetricsEvaluator:
             from tempo_tpu.util.devicetiming import timed_dispatch
 
             with _dispatch_lock:
+                # raw host arrays: the seam ships them (h2d bytes +
+                # transfer stage measured at the boundary)
                 out = timed_dispatch(
                     "mesh_bincount", scan,
-                    jnp.asarray(stacked.reshape(self.w, self.r, pad)),
-                    jnp.asarray(wstack.reshape(self.w, self.r, pad)),
+                    stacked.reshape(self.w, self.r, pad),
+                    wstack.reshape(self.w, self.r, pad),
                 )
                 counts = np.asarray(out).sum(axis=0, dtype=np.int64)
             acc.counts += counts

@@ -412,12 +412,15 @@ class MeshSearcher:
                     live.append(s)
                 scan = make_sharded_rle_scan(self.mesh, n_cols, self.max_codes, pad)
                 with _dispatch_lock:
+                    # host arrays go in raw: the timed_dispatch seam
+                    # ships them itself, so h2d bytes + transfer time
+                    # are measured where they happen
                     masks, _totals = timed_dispatch(
                         "mesh_rle_scan", scan,
-                        jnp.asarray(values.reshape(self.w, self.r, n_cols, run_pad)),
-                        jnp.asarray(lengths.reshape(self.w, self.r, n_cols, run_pad)),
-                        jnp.asarray(codes.reshape(self.w, self.r, n_cols, self.max_codes)),
-                        jnp.asarray(valid.reshape(self.w, self.r, pad)),
+                        values.reshape(self.w, self.r, n_cols, run_pad),
+                        lengths.reshape(self.w, self.r, n_cols, run_pad),
+                        codes.reshape(self.w, self.r, n_cols, self.max_codes),
+                        valid.reshape(self.w, self.r, pad),
                     )
                     masks_np = np.asarray(masks).reshape(cap, pad)
                 stats["units_runspace"] += len(live)
@@ -445,9 +448,9 @@ class MeshSearcher:
                 with _dispatch_lock:
                     masks, _totals = timed_dispatch(
                         "mesh_scan", scan,
-                        jnp.asarray(cols.reshape(self.w, self.r, n_cols, pad)),
-                        jnp.asarray(codes.reshape(self.w, self.r, n_cols, self.max_codes)),
-                        jnp.asarray(valid.reshape(self.w, self.r, pad)),
+                        cols.reshape(self.w, self.r, n_cols, pad),
+                        codes.reshape(self.w, self.r, n_cols, self.max_codes),
+                        valid.reshape(self.w, self.r, pad),
                     )
                     masks_np = np.asarray(masks).reshape(cap, pad)
                 stats["h2d_bytes"] += cols.nbytes + codes.nbytes + valid.nbytes

@@ -62,6 +62,13 @@ class _DropLabelsMixin:
                 v for k, v in self._values.items() if pairs.issubset(set(k))
             ))
 
+    def series(self) -> list:
+        """[(label dict, value)] snapshot — the per-series breakdown
+        status endpoints render (e.g. /status/device's per-kernel
+        transfer rollup) without re-parsing the exposition."""
+        with self._lock:
+            return [(dict(k), v) for k, v in self._values.items()]
+
 
 class Counter(_DropLabelsMixin):
     def __init__(self, name: str, help_: str = ""):

@@ -106,18 +106,38 @@ def _prune_device_profiles(keep: int = _DEVICE_PROFILE_KEEP) -> None:
         shutil.rmtree(stale, ignore_errors=True)
 
 
+def _ledger_window(mark: int) -> dict:
+    """Transfer-ledger view of the capture window: which (block, column)
+    pages shipped while the profiler ran, so the kernel trace and the
+    data movement it paid for are ONE correlated artifact."""
+    try:
+        from tempo_tpu.util import pageheat
+
+        return pageheat.LEDGER.window_report(mark)
+    except Exception as e:  # noqa: BLE001 — the link must not kill the capture
+        return {"error": str(e)}
+
+
 def capture_device_profile(seconds: float = 1.0, out_dir: str | None = None) -> dict:
     """Bounded jax.profiler capture: traces whatever device work runs in
     the window into a TensorBoard-loadable directory. Degrades honestly —
     {"supported": False, "error": ...} when the backend/profiler can't —
     because an admin endpoint that 500s under the exact conditions it
-    exists to debug is worse than useless."""
+    exists to debug is worse than useless.
+
+    Every response (including degraded ones) carries "transferLedger":
+    the page-heat accesses recorded over the SAME window, keyed off a
+    ledger sequence mark taken before the trace starts."""
     seconds = max(0.1, min(float(seconds), 30.0))
+    from tempo_tpu.util import pageheat
+
+    mark = pageheat.LEDGER.mark()
     try:
         import jax
         import jax.profiler  # noqa: F401
     except Exception as e:  # pragma: no cover - jax is baked in
-        return {"supported": False, "error": f"jax unavailable: {e}"}
+        return {"supported": False, "error": f"jax unavailable: {e}",
+                "transferLedger": _ledger_window(mark)}
     if out_dir is None:
         # mkdtemp: unique under rapid successive captures (a wall-clock
         # suffix collides within one second); old captures are pruned
@@ -126,7 +146,8 @@ def capture_device_profile(seconds: float = 1.0, out_dir: str | None = None) -> 
     try:
         jax.profiler.start_trace(out_dir)
     except Exception as e:
-        return {"supported": False, "error": f"profiler start failed: {e}"}
+        return {"supported": False, "error": f"profiler start failed: {e}",
+                "transferLedger": _ledger_window(mark)}
     try:
         time.sleep(seconds)
     finally:
@@ -134,7 +155,7 @@ def capture_device_profile(seconds: float = 1.0, out_dir: str | None = None) -> 
             jax.profiler.stop_trace()
         except Exception as e:
             return {"supported": False, "error": f"profiler stop failed: {e}",
-                    "dir": out_dir}
+                    "dir": out_dir, "transferLedger": _ledger_window(mark)}
     files = []
     for root, _dirs, names in os.walk(out_dir):
         for n in names:
@@ -145,4 +166,5 @@ def capture_device_profile(seconds: float = 1.0, out_dir: str | None = None) -> 
         "dir": out_dir,
         "files": sorted(files)[:200],
         "hint": "load with TensorBoard's profile plugin or xprof",
+        "transferLedger": _ledger_window(mark),
     }

@@ -11,8 +11,11 @@ with one bucket per pipeline stage:
   zonemap_prune  zone-map consults that skipped row groups
   fetch          backend ranged reads (coalesced page IO)
   decode         codec work materializing columns from fetched pages
+  transfer       host->device shipping of dispatch arguments (timed at
+                 the util/devicetiming seam; EXCLUSIVE of kernel)
   kernel         device dispatches (pallas/mesh), wall clock around
-                 block_until_ready (util/devicetiming.timed_dispatch)
+                 block_until_ready minus the transfer stage
+                 (util/devicetiming.timed_dispatch)
   merge          frontend-side partial merging across shards
   other          worker execution time not attributed to any stage
 
@@ -42,6 +45,7 @@ STAGES = (
     "zonemap_prune",
     "fetch",
     "decode",
+    "transfer",
     "kernel",
     "merge",
     "other",

@@ -82,6 +82,10 @@ _FIELD_FAMILIES = {
     "device_dispatches": (
         "tempo_tpu_usage_device_dispatches_total",
         "Host-level device dispatches issued"),
+    "transfer_bytes": (
+        "tempo_tpu_usage_transfer_bytes_total",
+        "Bytes moved across the host<->device boundary (h2d + d2h) by "
+        "device dispatches"),
 }
 FIELDS = {field: help_ for field, (_, help_) in _FIELD_FAMILIES.items()}
 

@@ -142,9 +142,16 @@ FAMILY_SERIES_BUDGETS = {
     # stage x kind waterfall
     "tempo_tpu_query_stage_seconds": 64,
     "tempo_tpu_query_device_dispatches_total": 8,
-    # kernel-labelled device timing
+    # kernel-labelled device timing + the data-movement plane
+    # (direction enum x kernel labels; kernels are code-literal strings)
     "tempo_tpu_device_dispatch_seconds": 32,
     "tempo_tpu_device_dispatches_total": 32,
+    "tempo_tpu_device_transfer_bytes_total": 96,
+    # page-heat ledger: label-less totals + a bounded budget-fraction
+    # enum on the what-if gauges (block/column must NEVER become labels
+    # here; per-page data belongs on /status/device)
+    "tempo_tpu_pageheat_miss_ratio": 8,
+    "tempo_tpu_pageheat_budget_bytes": 8,
     # component x reason sheds
     "tempo_tpu_shed_total": 32,
     # tenant-labelled families (eviction-bounded: ~T active tenants,
@@ -199,7 +206,7 @@ FAMILY_SERIES_BUDGETS = {
         "ingested_bytes", "ingested_spans", "flushed_bytes",
         "inspected_bytes", "decoded_bytes", "pages_fetched",
         "ranged_reads", "cache_hits", "cache_misses",
-        "device_seconds", "device_dispatches")},
+        "device_seconds", "device_dispatches", "transfer_bytes")},
 }
 
 

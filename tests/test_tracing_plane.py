@@ -633,11 +633,22 @@ class TestHTTPPropagation:
             with tracing.span("client-root") as root:
                 status, _, _ = client.request("GET", "/api/search?limit=5")
             assert status == 200
-            # the server's http span landed in the CLIENT's trace
-            http_spans = [
-                s for tl in exported for s in tl[0].all_spans()
-                if s.name.startswith("http/GET /api/search")
-            ]
+
+            # the server's http span landed in the CLIENT's trace. The
+            # server span closes AFTER it writes the response, so under
+            # host load the client can get here first — poll boundedly
+            # rather than flake on the export race.
+            def http_spans_now():
+                return [
+                    s for tl in exported for s in tl[0].all_spans()
+                    if s.name.startswith("http/GET /api/search")
+                ]
+
+            deadline = time.monotonic() + 5.0
+            http_spans = http_spans_now()
+            while not http_spans and time.monotonic() < deadline:
+                time.sleep(0.02)
+                http_spans = http_spans_now()
             assert http_spans, [
                 s.name for tl in exported for s in tl[0].all_spans()]
             assert http_spans[0].trace_id == root.trace_id

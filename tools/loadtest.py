@@ -723,6 +723,79 @@ def attribution_check(dist_url: str, query_url: str, tenants: list) -> dict:
     }
 
 
+def _device_check_one(url: str) -> dict:
+    """One process's device-transfer consistency verdict."""
+    try:
+        doc = _get_json(url + "/status/device", timeout=30)
+        with urllib.request.urlopen(url + "/metrics", timeout=15) as r:
+            met = r.read().decode()
+    except Exception as e:  # noqa: BLE001 — gate reports, caller decides
+        return {"error": str(e), "passed": False, "tracked_pages": 0}
+    ship_counter = 0.0
+    dispatches = 0.0
+    for line in met.splitlines():
+        if line.startswith("tempo_tpu_pageheat_ship_bytes_total"):
+            ship_counter += float(line.rsplit(" ", 1)[1])
+        elif line.startswith("tempo_tpu_device_dispatches_total"):
+            dispatches += float(line.rsplit(" ", 1)[1])
+    heat = doc.get("pageHeat", {})
+    moved = doc.get("transfer", {}).get("totals", {}).get("moved", 0)
+    # lifetime totals: eviction-immune, so equality is exact at quiesce
+    # no matter how the ledger GC'd during the run
+    ledger_total = heat.get("lifetimeMovedBytes", 0)
+    ledger_matches = abs(ledger_total - ship_counter) < 0.5
+    live = dispatches == 0 or moved > 0
+    bounded = heat.get("trackedPages", 0) <= 8192
+    curve = doc.get("whatIf", {}).get("curve", [])
+    monotone = all(curve[i]["missBytes"] >= curve[i + 1]["missBytes"]
+                   for i in range(len(curve) - 1))
+    return {
+        "ledger_moved_bytes": ledger_total,
+        "ship_bytes_counter": ship_counter,
+        "device_dispatches": dispatches,
+        "transfer_moved_bytes": moved,
+        "tracked_pages": heat.get("trackedPages", 0),
+        "curve_budgets": len(curve),
+        "gates": {
+            "ledger_matches_counter": ledger_matches,
+            "transfer_live": live,
+            "ledger_bounded": bounded,
+            "curve_monotone": monotone,
+        },
+        "passed": bool(ledger_matches and live and bounded and monotone),
+    }
+
+
+def device_transfer_check(urls: list, retries: int = 3) -> dict:
+    """Device data-movement gate (ISSUE 14) across every cluster process
+    (block reads heat the QUERIER's ledger, not the frontend's):
+
+    - ledger == counters: /status/device lifetimeMovedBytes equals
+      tempo_tpu_pageheat_ship_bytes_total on the same process's /metrics
+      (they move at the same statement; post-drain they must be equal —
+      a mismatch means a touch path bypassed the counter seam).
+    - live: some process that served block reads actually recorded page
+      heat, and any process with device dispatches shows moved bytes
+      (zero under dispatches>0 means the seam is dead code).
+    - bounded: trackedPages within the ledger's hard cap, so the RSS
+      gate's verdict covers the ledger by construction.
+    - the what-if curve each process serves is monotone in budget."""
+    last: dict = {}
+    for _ in range(max(1, retries)):
+        per = {name: _device_check_one(url) for name, url in urls}
+        heated = sum(p.get("tracked_pages", 0) for p in per.values())
+        last = {
+            "procs": per,
+            "total_tracked_pages": heated,
+            "passed": bool(all(p["passed"] for p in per.values())
+                           and heated > 0),
+        }
+        if last["passed"]:
+            return last
+        time.sleep(1.0)  # in-flight touches settle, then re-read
+    return last
+
+
 def storage_summary(query_url: str) -> dict:
     """Fleet storage health from the frontend's /status/storage — the
     same compression/debt/zone-map numbers bench_suite emits, so CI
@@ -972,12 +1045,22 @@ def main() -> int:
             print(f"[loadtest] attribution gate: {attr}", file=sys.stderr)
         summary["storage"] = storage_summary(query_url)
         print(f"[loadtest] storage health: {summary['storage']}", file=sys.stderr)
+        # post-drain (workload stopped, vulture stopped): the transfer
+        # ledger and its counters must agree exactly at quiesce — on
+        # every process (queriers do the block reads, not the frontend)
+        check_urls = ([(p.name, p.url) for p in procs] if procs
+                      else [("target", query_url)])
+        summary["device_transfer"] = device_transfer_check(check_urls)
+        device_ok = summary["device_transfer"]["passed"]
+        print(f"[loadtest] device-transfer gate: {summary['device_transfer']}",
+              file=sys.stderr)
         summary["passed"] = bool(
             summary["slo_pass"]
             and loss["passed"]
             and sweep_ok
             and attribution_ok
             and vulture_ok
+            and device_ok
             and (rss is None or summary["rss"]["passed"])
         )
         print(json.dumps(summary))
