@@ -629,6 +629,139 @@ def _graph_rep(reps: int = 3) -> dict:
         tmp.cleanup()
 
 
+def _standing_rep(reps: int = 3) -> dict:
+    """Standing-query rep (BENCH_r06+, ISSUE 15): the two halves of the
+    incremental-metrics lever on identical data.
+
+    (a) fold-vs-rescan: one standing fold of a cut-sized delta batch vs
+        a from-scratch evaluation of the accumulated store — the
+        O(delta)/O(re-scan) ratio dashboards actually buy;
+    (b) 30-day read: `rate() by (service)` over a month-spread store
+        served from step-partial columns vs the span path —
+        inspectedBytes collapse with results asserted bit-identical
+        (the span arm runs with TEMPO_TPU_STEP_PARTIALS=0 so the same
+        blocks read through span columns).
+    """
+    from tempo_tpu.backend import LocalBackend, TypedBackend
+    from tempo_tpu.encoding import from_version
+    from tempo_tpu.encoding.common import BlockConfig
+    from tempo_tpu.encoding.vtpu.colcache import shared_cache
+    from tempo_tpu.metrics_engine import (
+        HostAccumulator,
+        compile_metrics_plan,
+        evaluate_block,
+    )
+    from tempo_tpu.model import synth
+    from tempo_tpu.standing import StandingConfig, StandingEngine
+    from tempo_tpu.standing import rules as sp_rules
+
+    enc = from_version("vtpu1")
+    tmp = tempfile.TemporaryDirectory(dir=_bench_dir())
+    try:
+        backend = TypedBackend(LocalBackend(tmp.name))
+        cfg = BlockConfig(row_group_spans=2048)
+        # a month-spread store: 15 blocks x 2 days each, span times
+        # uniform within the block's window (make_batch packs times into
+        # one second; re-spread them over the window)
+        base_s = 1_700_000_000 - (1_700_000_000 % 3600)
+        day = 86400
+        metas = []
+        rng = np.random.default_rng(17)
+        for j in range(15):
+            b = synth.make_batch(512, 6, seed=300 + j)
+            w0 = (base_s - 30 * day) + j * 2 * day
+            t = (np.int64(w0) * 10**9
+                 + rng.integers(0, 2 * day * 10**9, size=b.num_spans))
+            b.cols["start_unix_nano"] = t.astype(np.uint64)
+            metas.append(enc.create_block([b.sorted_by_trace()], "bench",
+                                          backend, cfg))
+        q = "{} | rate() by (resource.service.name)"
+        start, end, step = base_s - 30 * day, base_s, 3600
+        plan = compile_metrics_plan(q, start, end, step)
+        rule = sp_rules.match_rule(plan, sp_rules.block_rules(cfg))
+        assert rule is not None
+
+        def read_arm(partial: bool):
+            cache = shared_cache()
+            if cache is not None:
+                cache.clear()  # every run pays its own IO
+            acc = HostAccumulator(plan)
+            bytes_read = 0
+            for m in metas:
+                blk = enc.open_block(m, backend, cfg)
+                if partial:
+                    sp_rules.evaluate_block_hybrid(plan, rule, blk, acc)
+                else:
+                    evaluate_block(plan, blk, acc)
+                bytes_read += blk.bytes_read
+            return acc, bytes_read
+
+        read_arm(True)  # warmup
+        read_arm(False)
+        t_part, t_span = [], []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            acc_p, bytes_p = read_arm(True)
+            t_part.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            acc_s, bytes_s = read_arm(False)
+            t_span.append(time.perf_counter() - t0)
+        parity = bool((acc_p.merged_counts() == acc_s.merged_counts()).all())
+        if not parity:
+            print("[bench] WARNING: standing rep partial/span arms DISAGREE",
+                  file=sys.stderr)
+
+        # (a) fold vs re-scan: a standing engine folds cut-sized deltas.
+        # Delta spans are stamped NOW-relative — the fold clamps its
+        # window to wall clock, so a fixed historical base would make
+        # every fold an empty early return and the timing a lie
+        eng = StandingEngine(StandingConfig(max_window_s=30 * day))
+        sq = eng.register("bench", q, step, window_s=30 * day)
+        now_s = int(time.time())
+        delta = synth.make_batch(256, 6, seed=999)
+        delta.cols["start_unix_nano"] = (
+            np.int64(now_s - 60) * 10**9
+            + rng.integers(0, 60 * 10**9, size=delta.num_spans)
+        ).astype(np.uint64)
+        delta = delta.sorted_by_trace()
+        eng.fold("bench", delta)  # warmup (jit-free host path, cache)
+        assert sq.counts and not sq.dirty, "fold arm evaluated nothing"
+        t_fold = []
+        for i in range(max(reps * 3, 6)):
+            t0 = time.perf_counter()
+            eng.fold("bench", delta)
+            t_fold.append(time.perf_counter() - t0)
+        fold_s = float(np.median(t_fold))
+        span_s = float(np.median(t_span))
+        assert sq.fold_spans > 0 and not sq.dirty
+        return {
+            "blocks": len(metas),
+            "spans": int(sum(m.total_spans for m in metas)),
+            "delta_spans": int(delta.num_spans),
+            "fold": {
+                "s": round(fold_s, 5),
+                "evals_per_s": round(1.0 / max(fold_s, 1e-9), 1),
+                "delta_spans_per_s": round(delta.num_spans / max(fold_s, 1e-9), 1),
+                # the incremental win: one fold vs re-scanning the store
+                "rescan_over_fold": round(span_s / max(fold_s, 1e-9), 1),
+            },
+            "read_30d": {
+                "partial_s": round(float(np.median(t_part)), 4),
+                "span_s": round(span_s, 4),
+                "paired_span_over_partial": round(float(np.median(
+                    [s / p for s, p in zip(t_span, t_part)])), 3),
+                "partial_bytes": int(bytes_p),
+                "span_bytes": int(bytes_s),
+                "bytes_ratio": round(bytes_s / max(bytes_p, 1), 2),
+                "partial_row_groups": int(acc_p.stats.get("partialRowGroups", 0)),
+                "span_columns_scanned": int(acc_p.stats.get("inspectedSpans", 0)),
+                "parity": parity,
+            },
+        }
+    finally:
+        tmp.cleanup()
+
+
 def _decode_rep(reps: int = 5) -> dict:
     """Per-codec decode throughput (MB/s of DECODED payload): the host
     entropy tier (zstd_shuffle via the native lib, zlib fallback) vs the
@@ -1062,6 +1195,12 @@ def _run(dog, partial: dict):
     partial["graph"] = graph_rep
     print(f"[bench] graph: {graph_rep}", file=sys.stderr)
 
+    # standing queries: fold-vs-rescan + the 30-day step-partial read
+    # vs the span path (ISSUE 15 tentpole)
+    standing_rep = _standing_rep()
+    partial["standing"] = standing_rep
+    print(f"[bench] standing: {standing_rep}", file=sys.stderr)
+
     med, spread = _stats(tpu_times)
     blocks_per_s = B_BLOCKS / med
     # paired per-rep ratios: epoch noise hits both arms of a pair, so the
@@ -1107,6 +1246,7 @@ def _run(dog, partial: dict):
         "metrics": metrics_rep,
         "decode": decode_rep,
         "graph": graph_rep,
+        "standing": standing_rep,
     }))
 
 

@@ -22,6 +22,7 @@ PATH_SEARCH = "/api/search"
 PATH_SEARCH_TAGS = "/api/search/tags"
 PATH_SEARCH_TAG_VALUES = "/api/search/tag"  # + /{name}/values
 PATH_METRICS_QUERY_RANGE = "/api/metrics/query_range"
+PATH_METRICS_STANDING = "/api/metrics/standing"  # + /{id}[/state]
 PATH_USAGE = "/api/usage"  # tenant-scoped cost rollup
 # trace-graph analytics plane (tempo_tpu/graph)
 PATH_GRAPH_DEPENDENCIES = "/api/graph/dependencies"
@@ -298,6 +299,38 @@ def parse_query_range_request(qs: dict, now_s: int | None = None) -> QueryRangeR
         raise BadRequest("maxSeries must be positive")
     if req.exemplars < 0:
         raise BadRequest("exemplars must be non-negative")
+    return req
+
+
+@dataclass
+class StandingReadRequest:
+    """GET /api/metrics/standing/{id}: optional start/end/step — all
+    default to the registration's own window/grid."""
+
+    start_s: int = 0
+    end_s: int = 0
+    step_s: int = 0
+
+
+def parse_standing_read_request(qs: dict) -> StandingReadRequest:
+    req = StandingReadRequest()
+    try:
+        req.start_s = int(_first(qs, "start", "0"))
+        req.end_s = int(_first(qs, "end", "0"))
+    except ValueError as e:
+        raise BadRequest(str(e)) from None
+    step_raw = _first(qs, "step")
+    if step_raw:
+        if step_raw.lstrip("-").isdigit():
+            req.step_s = int(step_raw)
+        else:
+            req.step_s = parse_duration_ns(step_raw) // 10**9
+        if req.step_s <= 0:
+            raise BadRequest("step must be positive")
+    if req.start_s < 0 or req.end_s < 0:
+        raise BadRequest("start/end must be non-negative")
+    if req.end_s and req.start_s and req.end_s <= req.start_s:
+        raise BadRequest("end must be after start")
     return req
 
 

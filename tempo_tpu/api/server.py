@@ -17,6 +17,7 @@ Routes:
   GET  /api/search/tags      tag names in recent data
   GET  /api/search/tag/{n}/values
   GET  /api/metrics/query_range   TraceQL metrics (Prometheus matrix)
+  POST/GET/DELETE /api/metrics/standing[/{id}[/state]]  standing queries
   GET  /api/graph/dependencies    stored-block service graph
   GET  /api/graph/critical-path   per-trace longest self-time paths
   GET  /api/graph/walks           seeded temporal random walks
@@ -169,6 +170,10 @@ class _Handler(BaseHTTPRequestHandler):
         p = path.rstrip("/") or "/"
         if p.startswith(api_params.PATH_TRACES + "/"):
             return api_params.PATH_TRACES + "/{traceID}"
+        if p.startswith(api_params.PATH_METRICS_STANDING + "/"):
+            if p.endswith("/state"):
+                return api_params.PATH_METRICS_STANDING + "/{id}/state"
+            return api_params.PATH_METRICS_STANDING + "/{id}"
         if p.startswith(api_params.PATH_SEARCH_TAG_VALUES + "/") and p.endswith("/values"):
             return api_params.PATH_SEARCH_TAG_VALUES + "/{name}/values"
         if p.startswith("/rpc/v1/worker/result/"):
@@ -324,6 +329,13 @@ class _Handler(BaseHTTPRequestHandler):
                 return 200
             self._send(202, b"")
             return 202
+
+        # standing queries (tempo_tpu/standing): registration +
+        # incremental reads + alert state, tenant-scoped. Served by
+        # ingester-owning processes (the cut path folds there).
+        if path == api_params.PATH_METRICS_STANDING or path.startswith(
+                api_params.PATH_METRICS_STANDING + "/"):
+            return self._standing(method, path, qs)
 
         if method != "GET" and path not in ("/flush", "/shutdown"):
             self._send_error(405, "method not allowed")
@@ -561,6 +573,16 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, pageheat.device_report(
                 budgets_bytes=budgets, top=top))
             return 200
+        if path == "/status/standing":
+            # operator view of the standing-query engine: registration
+            # and fold totals plus the per-tenant cut-delta counters the
+            # loadtest O(delta) gate compares against
+            eng = getattr(app, "standing", None)
+            if eng is None:
+                self._send_json(200, {"enabled": False})
+            else:
+                self._send_json(200, {"enabled": True, **eng.status()})
+            return 200
         if path == "/status/slo":
             # the burn-rate SLO engine's accounting document (util/slo):
             # per objective, the cumulative good/total the SLIs derive
@@ -616,6 +638,67 @@ class _Handler(BaseHTTPRequestHandler):
 
         self._send_error(404, "not found")
         return 404
+
+    # -- standing queries ----------------------------------------------
+    def _standing(self, method: str, path: str, qs: dict) -> int:
+        from tempo_tpu.standing import UnknownStandingQuery
+
+        app, org = self.app, self._org_id()
+        tail = path[len(api_params.PATH_METRICS_STANDING):].strip("/")
+        try:
+            if not tail:
+                if method == "POST":
+                    try:
+                        body = json.loads(self._body() or b"{}")
+                    except ValueError as e:
+                        raise BadRequest(f"bad json body: {e}") from e
+                    if not isinstance(body, dict):
+                        raise BadRequest("body must be a json object")
+                    try:
+                        doc = app.standing_register(body, org_id=org)
+                    except (ValueError, TypeError) as e:
+                        raise BadRequest(str(e)) from e
+                    self._send_json(200, doc)
+                    return 200
+                if method == "GET":
+                    self._send_json(200, {"queries": app.standing_list(org_id=org)})
+                    return 200
+                self._send_error(405, "method not allowed")
+                return 405
+            parts = tail.split("/")
+            qid = parts[0]
+            if len(parts) == 2 and parts[1] == "state" and method == "GET":
+                self._send_json(200, app.standing_state(qid, org_id=org))
+                return 200
+            if len(parts) != 1:
+                self._send_error(404, "not found")
+                return 404
+            if method == "DELETE":
+                app.standing_delete(qid, org_id=org)
+                self._send(204, b"", "text/plain; charset=utf-8")
+                return 204
+            if method == "GET":
+                req = api_params.parse_standing_read_request(qs)
+                try:
+                    doc = app.standing_read(qid, org_id=org,
+                                            start_s=req.start_s,
+                                            end_s=req.end_s,
+                                            step_s=req.step_s)
+                except ValueError as e:
+                    raise BadRequest(str(e)) from e
+                stats = doc.pop("stats", {})
+                self._send_json(200, {
+                    "status": "success",
+                    "data": {"resultType": doc["resultType"],
+                             "result": doc["result"]},
+                    "metrics": stats,
+                })
+                return 200
+            self._send_error(405, "method not allowed")
+            return 405
+        except UnknownStandingQuery:
+            self._send_error(404, "no such standing query")
+            return 404
 
     # -- query handlers ------------------------------------------------
     def _trace_by_id(self, tail: str, qs: dict) -> int:
@@ -764,6 +847,11 @@ _ENDPOINTS = [
     "GET /api/search/tags",
     "GET /api/search/tag/{name}/values",
     "GET /api/metrics/query_range",
+    "POST /api/metrics/standing",
+    "GET /api/metrics/standing",
+    "GET /api/metrics/standing/{id}",
+    "GET /api/metrics/standing/{id}/state",
+    "DELETE /api/metrics/standing/{id}",
     "GET /api/graph/dependencies",
     "GET /api/graph/critical-path",
     "GET /api/graph/walks",
@@ -783,6 +871,7 @@ _ENDPOINTS = [
     "GET /status/usage",
     "GET /status/usage-stats",
     "GET /status/slo",
+    "GET /status/standing",
     "GET /status/storage",
     "GET /status/runtime_config",
     "POST /flush",

@@ -36,6 +36,7 @@ from tempo_tpu.modules.worker import JobBroker, LocalWorkerPool, RemoteWorker
 from tempo_tpu.util import devicetiming  # noqa: F401 — registers the
 # device-dispatch histograms so /metrics exposes them from boot, not
 # from the first dispatch
+from tempo_tpu.standing import StandingConfig, StandingEngine
 from tempo_tpu.util import resource, slo, tracing
 from tempo_tpu.vulture import VultureConfig
 
@@ -107,6 +108,10 @@ class AppConfig:
     # burn-rate SLO engine (util/slo.py): SLIs over this process's own
     # counters -> tempo_tpu_slo_* gauges + /status/slo
     slo: "slo.SLOConfig" = field(default_factory=slo.SLOConfig)
+    # standing-query engine (tempo_tpu/standing): registered query_range
+    # queries fold each ingest cut's delta into per-query accumulators
+    # (O(new spans) per evaluation); lives beside the ingesters
+    standing: "StandingConfig" = field(default_factory=StandingConfig)
 
 
 class RoleUnavailable(RuntimeError):
@@ -162,10 +167,18 @@ class App:
         self._self_export_client = None
         self.vulture = None
         self.slo_engine = None
+        # built BEFORE the ingesters so the cut path holds a stable
+        # reference; storage/WAL wiring attaches after the role build
+        self.standing = (
+            StandingEngine(cfg.standing, overrides=self.overrides,
+                           governor=self.governor)
+            if cfg.standing.enabled and target in ("all", "ingester") else None
+        )
         if target == "all":
             self._build_all()
         else:
             self._build_role(target)
+        self._maybe_standing_attach()
         self._maybe_self_tracing()
         self._maybe_storage_scanner()
         self._maybe_pageheat_exporter()
@@ -227,7 +240,8 @@ class App:
             sub_cfg.wal_path = (cfg.db.wal_path or "wal") + f"/{iid}"
             ing_db = TempoDB(sub_cfg, raw_backend=self.db.backend.raw)
             ing_db.blocklist = self.db.blocklist  # shared world view
-            ing = Ingester(ing_db, self.overrides, cfg.ingester, instance_id=iid)
+            ing = Ingester(ing_db, self.overrides, cfg.ingester, instance_id=iid,
+                           standing=self.standing)
             self.ingesters[iid] = ing
             self.ring.register(iid)
             self._registered.append((self.ring, iid))
@@ -277,7 +291,8 @@ class App:
             sub_cfg = DBConfig(**{**cfg.db.__dict__})
             sub_cfg.wal_path = (cfg.db.wal_path or "wal") + f"/{iid}"
             self.db = TempoDB(sub_cfg)
-            ing = Ingester(self.db, self.overrides, cfg.ingester, instance_id=iid)
+            ing = Ingester(self.db, self.overrides, cfg.ingester, instance_id=iid,
+                           standing=self.standing)
             self.ingesters[iid] = ing
             self.ring = Ring(self._ring_kv(), replication_factor=cfg.replication_factor,
                              heartbeat_timeout_s=cfg.ring_heartbeat_timeout_s)
@@ -372,6 +387,21 @@ class App:
             return
 
         raise AssertionError(role)
+
+    def _maybe_standing_attach(self):
+        """Late wiring of the standing engine: storage for restart
+        rebuilds, the ingesters for the read tail / WAL replay, and the
+        WAL root for the registration snapshot. Loads the snapshot and
+        rebuilds restored accumulators exactly from step partials +
+        the rescanned WAL."""
+        if self.standing is None:
+            return
+        if not self.ingesters:
+            self.standing = None  # engine serves nothing without a cut path
+            return
+        snap_dir = self.cfg.db.wal_path or "wal"
+        self.standing.attach(db=self.db, ingesters=self.ingesters,
+                             snapshot_dir=snap_dir)
 
     def _maybe_vulture(self):
         """In-process prober on the all-in-one target (the reference
@@ -567,6 +597,40 @@ class App:
             self.resolve_tenant(org_id), q, start_s, end_s, **kw
         )
 
+    # -- standing queries -------------------------------------------------
+    def _standing(self):
+        return self._require(self.standing, "standing queries")
+
+    def standing_register(self, body: dict, org_id=None) -> dict:
+        """POST /api/metrics/standing: register a query_range query for
+        incremental evaluation (validated by the exact metrics grammar/
+        planner; caps via standing config + per-tenant Limits)."""
+        tenant = self.resolve_tenant(org_id)
+        q = self._standing().register(
+            tenant,
+            query=str(body.get("q") or body.get("query") or ""),
+            step_s=int(body.get("step", 0)),
+            window_s=int(body.get("window", 0)),
+            alert=body.get("alert"),
+            max_series=int(body.get("maxSeries", 64)),
+        )
+        return q.to_doc()
+
+    def standing_list(self, org_id=None) -> list[dict]:
+        return self._standing().list(self.resolve_tenant(org_id))
+
+    def standing_read(self, qid: str, org_id=None, start_s: int = 0,
+                      end_s: int = 0, step_s: int = 0) -> dict:
+        return self._standing().read(self.resolve_tenant(org_id), qid,
+                                     start_s=start_s, end_s=end_s,
+                                     step_s=step_s)
+
+    def standing_state(self, qid: str, org_id=None) -> dict:
+        return self._standing().state(self.resolve_tenant(org_id), qid)
+
+    def standing_delete(self, qid: str, org_id=None) -> None:
+        self._standing().delete(self.resolve_tenant(org_id), qid)
+
     def search_tags(self, org_id=None) -> list[str]:
         """Reference: /api/search/tags is proxied by the frontend straight
         to queriers (no sharding middleware)."""
@@ -608,7 +672,7 @@ class App:
     def service_states(self) -> dict:
         states = {"target": self.target}
         for name in ("distributor", "querier", "frontend", "compactor",
-                     "generator", "vulture", "slo_engine"):
+                     "generator", "vulture", "slo_engine", "standing"):
             if getattr(self, name) is not None:
                 states[name] = "Running"
         for iid in self.ingesters:
@@ -646,6 +710,10 @@ class App:
             self.remote_worker.stop()
         for ing in self.ingesters.values():
             ing.stop(flush=True)
+        if self.standing is not None:
+            # after the ingester drain: the final cuts' folds land first,
+            # then registrations + state snapshot to the WAL dir
+            self.standing.stop()
         if self.workers is not None:
             self.workers.stop()
         elif self.broker is not None:

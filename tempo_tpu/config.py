@@ -29,6 +29,7 @@ from tempo_tpu.modules.frontend import FrontendConfig
 from tempo_tpu.modules.generator.storage import RemoteWriteConfig
 from tempo_tpu.modules.ingester import IngesterConfig
 from tempo_tpu.modules.overrides import Limits
+from tempo_tpu.standing import StandingConfig
 from tempo_tpu.usagestats import UsageStatsConfig
 from tempo_tpu.util import slo as slo_mod
 from tempo_tpu.util.resource import ResourceConfig
@@ -192,6 +193,8 @@ def parse_config(text: str, env: dict | None = None) -> Config:
     # continuous-verification prober (in-process on target=all, or the
     # whole process when target=vulture)
     app.vulture = _from_dict(VultureConfig, doc.pop("vulture", None), "vulture")
+    # standing-query engine (registration caps, snapshot cadence, tail)
+    app.standing = _from_dict(StandingConfig, doc.pop("standing", None), "standing")
     # burn-rate SLO engine; objectives is a LIST of dataclasses, handled
     # like distributor.forwarders
     slo_doc = doc.pop("slo", {}) or {}
@@ -323,6 +326,43 @@ def check_config(cfg: Config) -> list[str]:
                 f"exceeds recent_min_age_s ({app.vulture.recent_min_age_s}s): "
                 "some cycles have no fresh-tier probe to check"
             )
+    # -- standing queries + step-partial downsampling tier ---------------
+    if app.standing.enabled and app.multitenancy_enabled \
+            and app.standing.max_queries_per_tenant <= 0:
+        warnings.append(
+            "standing.max_queries_per_tenant is unset in a multitenant "
+            "cluster: any tenant can register unbounded standing queries, "
+            "each evaluated on every ingest cut (set the cap, or per-tenant "
+            "overrides.max_standing_queries)"
+        )
+    from tempo_tpu.standing import rules as _sp_rules
+
+    for rule in _sp_rules.parse_rules(
+            tuple(tuple(r) for r in (app.db.block.step_partial_rules or ()))):
+        if rule.step_s > app.ingester.max_block_duration_s:
+            warnings.append(
+                f"step-partial rule {rule.name!r} step ({rule.step_s}s) is "
+                "coarser than ingester.max_block_duration_s "
+                f"({app.ingester.max_block_duration_s:g}s): a flushed block "
+                "spans less than one step, so its partial degenerates to a "
+                "single bin and downsampled reads gain nothing over spans"
+            )
+        try:
+            from tempo_tpu.metrics_engine.plan import MAX_SLOTS
+
+            t = _sp_rules.rule_template(rule)
+            day_bins = max(1, 86400 // rule.step_s)
+            if rule.max_series * day_bins * t.n_buckets > MAX_SLOTS:
+                warnings.append(
+                    f"step-partial rule {rule.name!r} series ceiling "
+                    f"({rule.max_series} series x {day_bins} bins/day x "
+                    f"{t.n_buckets} buckets) exceeds plan.MAX_SLOTS "
+                    f"({MAX_SLOTS}): day-scale reads of this rule cannot "
+                    "fit one slot space — raise the step or lower the "
+                    "ceiling"
+                )
+        except Exception:  # noqa: BLE001 — an uncompilable rule already
+            pass  # warned at parse_rules time (dropped loudly)
     if app.slo.enabled:
         for obj in (app.slo.objectives or slo_mod.default_objectives()):
             if obj.sli not in slo_mod.SLI_SOURCES:

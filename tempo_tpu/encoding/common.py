@@ -23,6 +23,16 @@ class BlockConfig:
     hll_precision: int = 12
     # shape buckets for device kernels: pad-to-power-of-two within [min,max]
     min_device_bucket: int = 1 << 10
+    # step-partial downsampling rules (standing/rules.py): per block,
+    # pre-bucketed (series, step-bin) count columns are written for each
+    # rule — (name, filter-less metrics query, step seconds, series
+    # ceiling) — and a matching query_range reads them instead of span
+    # columns. () disables the tier; TEMPO_TPU_STEP_PARTIALS=0 is the
+    # process-wide kill switch.
+    step_partial_rules: tuple = (
+        ("rate_by_service", "{} | rate() by (resource.service.name)", 60, 512),
+        ("duration_hist", "{} | histogram_over_time(duration)", 60, 1),
+    )
 
     def bucket_for(self, n: int) -> int:
         """Static kernel shape for an n-row group (next pow2, floored)."""

@@ -229,6 +229,11 @@ class BlockWriter:
         self.bytes_copied_verbatim = 0
         self.bytes_reencoded = 0
         self.row_groups_relocated = 0
+        # step-partial downsampling tier (standing/rules.py): rules this
+        # writer materializes per row group; () disables
+        from tempo_tpu.standing import rules as sp_rules
+
+        self.step_rules = sp_rules.block_rules(cfg)
 
     # ------------------------------------------------------------------
     def _add_rg(self, rg: fmt.RowGroupMeta) -> None:
@@ -251,13 +256,64 @@ class BlockWriter:
         self._n_traces += len(firsts)
         if self.collect_ids:
             self._unique_ids.append(batch.cols["trace_id"][firsts])
+        partials = self._batch_partials(batch)
         for lo, hi in fmt.row_group_slices(batch, self.cfg.row_group_spans):
             payload, rg = fmt.serialize_row_group(batch, lo, hi, self.offset, self.cfg.codec)
             self.backend.append_named(self.meta, DataName, payload)
             self.offset += len(payload)
             self.pages_reencoded += len(rg.pages)
             self.bytes_reencoded += len(payload)
+            self._write_partials(rg, partials, lo, hi)
             self._add_rg(rg)
+
+    def _batch_partials(self, batch) -> list:
+        """Per-row (series, abs-bin, bucket) decomposition of the batch
+        under every configured downsampling rule — computed once per
+        batch, sliced per row group. A rule that can't describe this
+        batch exactly (series over ceiling, wild timestamps) yields no
+        partial: readers fall back to the span path, never a wrong one."""
+        out = []
+        for rule in self.step_rules:
+            try:
+                from tempo_tpu.standing import rules as sp_rules
+
+                bp = sp_rules.batch_partial(batch, self.dictionary, rule)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "step-partial rule %s skipped for this batch", rule.name)
+                bp = None
+            if bp is not None:
+                out.append(bp)
+        return out
+
+    def _write_partials(self, rg: fmt.RowGroupMeta, partials: list,
+                        lo: int, hi: int) -> None:
+        """Append this row group's step-partial tables as ordinary pages
+        right after its column pages (contiguous, so relocation's single
+        ranged read and the coalesced span reads both cover them)."""
+        from tempo_tpu.encoding.vtpu import codec as codec_mod
+        from tempo_tpu.standing import rules as sp_rules
+
+        for bp in partials:
+            table = bp.rg_table(lo, hi)
+            if table is None:
+                continue
+            keys, arr = table
+            page, crc = codec_mod.encode(arr, codec_mod.resolve_codec(self.cfg.codec))
+            name = sp_rules.page_name(bp.rule.name)
+            rg.pages[name] = fmt.PageMeta(
+                offset=self.offset, length=len(page), dtype=arr.dtype.str,
+                shape=tuple(arr.shape), codec=codec_mod.resolve_codec(self.cfg.codec),
+                crc=crc,
+            )
+            rg.partials[bp.rule.name] = sp_rules.partial_meta(bp.rule, keys)
+            self.backend.append_named(self.meta, DataName, page)
+            self.offset += len(page)
+            self.pages_reencoded += 1
+            self.bytes_reencoded += len(page)
+            sp_rules.partial_pages_written_total.inc()
 
     def append_relocated(self, rg: fmt.RowGroupMeta, raw_pages: dict,
                          reencode: dict, min_id: str, max_id: str,
@@ -368,6 +424,11 @@ class BlockWriter:
             n_spans=rg.n_spans, n_attrs=rg.n_attrs, min_id=min_id,
             max_id=max_id, start_s=rg.start_s, end_s=rg.end_s,
             n_traces=n_traces, pages=pages, stats=stats,
+            # step partials relocate with their rows: series keys are
+            # strings (dictionary-independent), the count page moved
+            # verbatim above, and relocation never drops/dedupes spans —
+            # so the copied tables still describe exactly these rows
+            partials=dict(rg.partials),
         ))
 
     # ------------------------------------------------------------------

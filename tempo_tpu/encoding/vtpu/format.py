@@ -119,6 +119,12 @@ class RowGroupMeta:
     # zone maps: column -> [min, max] | [codes...]; {} on blocks written
     # before stats existed (readers must treat absence as "unknown")
     stats: dict = field(default_factory=dict)
+    # step-partial downsampling tier (standing/rules.py): rule name ->
+    # {"series": [keys], "step": s, "q": query}; the count table itself
+    # is an ordinary page in `pages` under the reserved "__sp." prefix.
+    # {} on blocks written before the tier existed (absence = evaluate
+    # the spans, never wrong)
+    partials: dict = field(default_factory=dict)
 
     def to_json(self):
         d = {
@@ -133,6 +139,8 @@ class RowGroupMeta:
         }
         if self.stats:
             d["stats"] = self.stats
+        if self.partials:
+            d["partials"] = self.partials
         return d
 
     @staticmethod
@@ -147,6 +155,7 @@ class RowGroupMeta:
             n_traces=d.get("n_traces", 0),
             pages={k: PageMeta.from_json(v) for k, v in d["pages"].items()},
             stats=d.get("stats", {}),
+            partials=d.get("partials", {}),
         )
 
 

@@ -94,10 +94,19 @@ class VtpuWalBlock:
         """Replay all decodable segments; corrupt segments are dropped
         with a warning (reference: partial WAL replay warns + continues,
         tempodb/wal/wal.go:124-147)."""
+        for _, batch in self.iter_batches_keyed():
+            yield batch
+
+    def iter_batches_keyed(self):
+        """(segment index, batch) pairs, the index parsed from the ON-DISK
+        file name — the identity the ingester cut path stamps on standing
+        folds must survive a corrupt segment being skipped, so enumerate
+        order is never a substitute."""
         for seg in self._segments():
             try:
+                idx = int(os.path.basename(seg)[: -len(SEG_SUFFIX)])
                 with open(seg, "rb") as f:
-                    yield fmt.deserialize_batch(f.read())
+                    yield idx, fmt.deserialize_batch(f.read())
             except Exception as e:  # corrupt/truncated segment
                 log.warning("wal: dropping corrupt segment %s: %s", seg, e)
 

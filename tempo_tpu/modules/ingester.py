@@ -81,12 +81,16 @@ class IngesterConfig:
 
 class TenantInstance:
     def __init__(self, tenant: str, db, overrides, cfg: IngesterConfig,
-                 governor: "resource.ResourceGovernor | None" = None):
+                 governor: "resource.ResourceGovernor | None" = None,
+                 standing=None):
         self.tenant = tenant
         self.db = db
         self.overrides = overrides
         self.cfg = cfg
         self.governor = governor or resource.governor()
+        # standing-query engine (tempo_tpu/standing): the cut path folds
+        # each cut's delta into registered per-query accumulators
+        self.standing = standing
         self.lock = threading.Lock()
         self.live: dict[bytes, LiveTrace] = {}
         self.head = db.wal.new_block(tenant)
@@ -205,9 +209,20 @@ class TenantInstance:
             with self.lock:
                 self.head.append(batch)
                 self.head._gov_bytes = getattr(self.head, "_gov_bytes", 0) + cut_bytes
+                # WAL segment identity of this cut (block id + segment
+                # index): the standing fold below carries it so a
+                # concurrent rebuild that already replayed the segment
+                # can dedupe the in-flight fold exactly
+                seg_key = (f"{self.head.block_id}:"
+                           f"{getattr(self.head, '_next_seg', 1) - 1}")
         except BaseException:
             wal_pool.sub(cut_bytes)  # append failed: nothing to account
             raise
+        # standing-query fold: evaluate every registered query against
+        # ONLY this cut's spans — O(delta), outside the instance lock
+        # (the engine serializes itself), and never fatal to the cut
+        if self.standing is not None:
+            self.standing.fold(self.tenant, batch, seg_key=seg_key)
         return len(cut)
 
     def cut_block_if_ready(self, now: float | None = None, immediate: bool = False):
@@ -372,16 +387,46 @@ class TenantInstance:
             segs.extend(blk.iter_batches())
         return segs
 
+    def live_only_batches(self) -> list[SpanBatch]:
+        """Uncut live-trace segments ONLY (no WAL): the standing-query
+        read tail. Cut spans are already in the standing accumulator —
+        including the WAL here would double-count every cut."""
+        with self.lock:
+            return [seg for lt in self.live.values() for seg in lt.segments]
+
+    def wal_segment_batches(self) -> list[tuple[str, SpanBatch]]:
+        """(segment key, batch) for every WAL segment (head + completing)
+        — the standing rebuild's replay source. Keys match the cut
+        path's fold keys ("<block_id>:<seg index>") so a rebuild and an
+        in-flight fold can never double-count one segment."""
+        with self.lock:
+            wal_blocks = [self.head] + list(self.completing)
+        out = []
+        for blk in wal_blocks:
+            keyed = getattr(blk, "iter_batches_keyed", None)
+            if keyed is not None:
+                # keys come from the on-disk segment numbers, so a
+                # skipped corrupt segment cannot shift later segments
+                # onto the wrong fold keys
+                for i, batch in keyed():
+                    out.append((f"{blk.block_id}:{i}", batch))
+            else:  # encodings without keyed replay: enumerate order
+                for i, batch in enumerate(blk.iter_batches()):
+                    out.append((f"{blk.block_id}:{i}", batch))
+        return out
+
 
 class Ingester:
     def __init__(self, db, overrides, cfg: IngesterConfig | None = None,
                  instance_id: str = "ingester-0",
-                 governor: "resource.ResourceGovernor | None" = None):
+                 governor: "resource.ResourceGovernor | None" = None,
+                 standing=None):
         self.db = db
         self.overrides = overrides
         self.cfg = cfg or IngesterConfig()
         self.instance_id = instance_id
         self.governor = governor or resource.governor()
+        self.standing = standing  # StandingEngine or None
         self.instances: dict[str, TenantInstance] = {}
         self.lock = threading.Lock()
         self._stop = threading.Event()
@@ -396,7 +441,8 @@ class Ingester:
             inst = self.instances.get(tenant)
             if inst is None:
                 inst = TenantInstance(tenant, self.db, self.overrides, self.cfg,
-                                      governor=self.governor)
+                                      governor=self.governor,
+                                      standing=self.standing)
                 self.instances[tenant] = inst
             return inst
 
@@ -422,6 +468,30 @@ class Ingester:
         with self.lock:
             inst = self.instances.get(tenant)
         return inst.live_batches() if inst else []
+
+    # -- standing-query seams -------------------------------------------
+    def standing_live_batches(self, tenant: str) -> list[SpanBatch]:
+        """Uncut live-trace tail (standing reads)."""
+        with self.lock:
+            inst = self.instances.get(tenant)
+        return inst.live_only_batches() if inst else []
+
+    def standing_wal_batches(self, tenant: str) -> list:
+        """Keyed WAL segments (standing rebuild replay)."""
+        with self.lock:
+            inst = self.instances.get(tenant)
+        return inst.wal_segment_batches() if inst else []
+
+    def standing_flushed_since(self, tenant: str, t: float) -> list[str]:
+        """Block ids flushed at or after t (the standing rebuild's
+        flush-race detector: a block completing mid-rebuild is visible
+        in neither the blocklist snapshot nor the cleared WAL)."""
+        with self.lock:
+            inst = self.instances.get(tenant)
+        if inst is None:
+            return []
+        with inst.lock:
+            return [str(meta.block_id) for meta, at in inst.flushed if at >= t]
 
     # -- lifecycle -------------------------------------------------------
     def replay(self) -> None:

@@ -45,6 +45,10 @@ class Limits:
     # max_failed_shard_fraction); 0 = any terminal shard failure fails
     # the query (strict completeness)
     query_partial_shard_fraction: float = -1.0
+    # standing queries: registrations this tenant may hold (0 = inherit
+    # standing.max_queries_per_tenant; each registration is evaluated on
+    # every ingest cut, so the cap bounds per-cut fold work)
+    max_standing_queries: int = 0
     # storage
     block_retention_s: int = 0  # 0 = fall back to engine default
     # generator

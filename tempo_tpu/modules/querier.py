@@ -216,8 +216,31 @@ class Querier:
                 metas.append(self.db.backend.block_meta(tenant, bid))
             except NotFound:  # deleted mid-query: benign; other errors
                 log.warning("metrics job: block %s deleted mid-query", bid)
+        # step-partial downsampling tier (standing/rules.py): a plan a
+        # configured rule can answer exactly reads pre-bucketed count
+        # pages row-group-wise instead of span columns — span-column
+        # fetch bytes ~0, results bit-identical (legacy row groups fall
+        # back to the span path inside the hybrid evaluator). The mesh
+        # gains nothing on partial-bearing blocks (the fold is integer
+        # adds over kilobytes) — but a matched PLAN over an all-legacy
+        # store must not lose the mesh path, so with a mesh attached the
+        # first block's index is probed for an actual partial before the
+        # tier claims the job.
+        from tempo_tpu.standing import rules as sp_rules
+
+        sp_rule = (sp_rules.match_rule(plan, sp_rules.block_rules(self.db.cfg.block))
+                   if all(m.version == "vtpu1" for m in metas) else None)
         evaluator = self.db.mesh_metrics_evaluator()
-        if evaluator is not None and len(metas) > 1 and all(
+        if sp_rule is not None and evaluator is not None and metas:
+            try:
+                probe = self.db.encoding_for(metas[0].version).open_block(
+                    metas[0], self.db.backend, self.db.cfg.block)
+                if not any(sp_rules.rg_has_partial(rg, sp_rule)
+                           for rg in probe.index().row_groups):
+                    sp_rule = None  # legacy store: keep the device path
+            except Exception:
+                log.exception("step-partial probe failed; using span path")
+        if sp_rule is None and evaluator is not None and len(metas) > 1 and all(
             m.version == "vtpu1" for m in metas
         ):
             acc = make_accumulator(plan, device=False)
@@ -243,7 +266,10 @@ class Querier:
                 blk = self.db.encoding_for(meta.version).open_block(
                     meta, self.db.backend, self.db.cfg.block)
                 sub.stats["inspectedBlocks"] += 1
-                evaluate_block(plan, blk, sub)
+                if sp_rule is not None:
+                    sp_rules.evaluate_block_hybrid(plan, sp_rule, blk, sub)
+                else:
+                    evaluate_block(plan, blk, sub)
                 sub.stats["inspectedBytes"] += blk.bytes_read
                 sub.stats["decodedBytes"] += getattr(blk, "decoded_bytes", 0)
 

@@ -1,0 +1,33 @@
+"""Standing-query subsystem: incremental streaming metrics + the
+step-partial downsampling tier.
+
+Two halves of one lever (ROADMAP item 2 / TiLT + RESYSTANCE in
+PAPERS.md — stream queries compile to incremental operators; work moves
+to where the data already is):
+
+- `engine.py` — registered `query_range` queries evaluate incrementally
+  against live ingest: each cut's delta folds into a per-query standing
+  accumulator, so thousands of dashboards/alert rules cost O(new
+  spans), not O(re-scan). Alerting on `{...} | rate() > X` falls out as
+  a threshold check on the same accumulator.
+- `rules.py` — flush and compaction write per-block pre-bucketed
+  (series, bin) count columns for a small configured rule set, so a
+  30-day `query_range` matching a rule reads step partials with zero
+  span-column fetches (and a restart rebuilds standing accumulators
+  from the same partials).
+"""
+
+from tempo_tpu.standing.engine import (  # noqa: F401
+    StandingConfig,
+    StandingEngine,
+    StandingQuery,
+    UnknownStandingQuery,
+)
+from tempo_tpu.standing.rules import (  # noqa: F401
+    DEFAULT_STEP_RULES,
+    StepRule,
+    block_rules,
+    evaluate_block_hybrid,
+    match_rule,
+    step_partials_enabled,
+)

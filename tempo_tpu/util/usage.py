@@ -92,7 +92,7 @@ FIELDS = {field: help_ for field, (_, help_) in _FIELD_FAMILIES.items()}
 # workload kinds a vector can settle under (bounded: the `kind` label
 # must never carry request-derived strings)
 KINDS = ("ingest", "find", "search", "query_range", "traceql", "graph",
-         "compaction", "analytics")
+         "compaction", "analytics", "standing")
 
 _counters = {
     field: metrics.counter(family, help_ + ", by tenant and workload kind")

@@ -38,6 +38,12 @@ after an ingester hands a block off — the metrics recent job scans
 live/WAL only (flushed blocks would double-count) while the block jobs
 see the blocklist as of the last poll. A metrics_mismatch that heals
 within one poll interval is that gap; one that persists is real.
+STANDING-query reads (tempo_tpu/standing, /api/metrics/standing) are
+immune by construction — the cut's delta is already in the standing
+accumulator before the block ever reaches the backend — so dashboards
+and alert rules that must not see the dip should register standing
+queries; the tolerance above applies only to ad-hoc query_range
+(regression-pinned by tests/test_standing.py TestHandoffDip).
 """
 
 from __future__ import annotations

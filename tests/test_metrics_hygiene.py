@@ -194,6 +194,12 @@ FAMILY_SERIES_BUDGETS = {
     "tempo_tpu_slo_burning": 32,
     # query-insights capture counter: kind x reason enums
     "tempo_tpu_query_insights_total": 32,
+    # standing-query plane: per-tenant registration gauge (bounded by
+    # registration caps + tenant count) and a per-query-id alert gauge
+    # (bounded by standing.max_queries_per_tenant x tenants; ids are
+    # dropped at deregistration)
+    "tempo_tpu_standing_queries": 64,
+    "tempo_tpu_standing_alert_firing": 64,
     # trace-graph analytics plane: label-less totals + a small kind enum
     # (dependencies | critical_path | walks) — edges/services must NEVER
     # become labels here; per-edge data belongs in query responses

@@ -1,0 +1,816 @@
+"""Standing-query subsystem (ISSUE 15): incremental streaming metrics +
+the step-partial downsampling tier.
+
+The load-bearing invariants, each with a test:
+
+- bit-exactness at cut boundaries: the standing read (accumulator +
+  uncut live tail) equals a from-scratch query_range over the same
+  window — at 1/2/4 ingester shards, on the host and device fold arms,
+  and across a crash-restart with TEMPO_TPU_FAULTS armed;
+- no handoff dip (the PR 11 known transient, fixed at its root for
+  standing reads): spans invisible to query_range for up to
+  blocklist_poll_s after an ingester hands a block off must not dent
+  standing output — the accumulator already holds the cut's delta;
+- step-partial reads are bit-identical to span-path reads on compacted
+  fixtures (both relocation-copied and merge-recomputed row groups)
+  with span-column fetch bytes ~0;
+- governor/caps/usage wiring: folds shed at PRESSURE before ingest
+  refuses, registration caps per tenant, cost metered under kind
+  "standing".
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tempo_tpu.app import App, AppConfig
+from tempo_tpu.backend import LocalBackend, TypedBackend
+from tempo_tpu.db import DBConfig
+from tempo_tpu.encoding import from_version
+from tempo_tpu.encoding.common import BlockConfig
+from tempo_tpu.model import synth
+from tempo_tpu.modules.overrides import Limits
+from tempo_tpu.standing import StandingConfig, StandingEngine, rules as sp_rules
+from tempo_tpu.util import resource, usage
+
+RATE_Q = "{} | rate() by (resource.service.name)"
+HIST_Q = "{} | histogram_over_time(duration)"
+
+
+def _mk_app(tmp, **kw):
+    return App(AppConfig(
+        db=DBConfig(backend="local", backend_path=str(tmp / "blocks"),
+                    wal_path=str(tmp / "wal")),
+        generator_enabled=False, **kw,
+    ))
+
+
+def _aligned_base(step: int = 60, ago_s: int = 600) -> int:
+    return (int(time.time()) // step) * step - ago_s
+
+
+def _vals(mat: dict):
+    """Canonical (metric, samples) set of a Prometheus matrix."""
+    return sorted(
+        (tuple(sorted(r["metric"].items())), tuple(map(tuple, r["values"])))
+        for r in mat["result"]
+    )
+
+
+def _cut_all(app, immediate=True):
+    for ing in app.ingesters.values():
+        for inst in list(ing.instances.values()):
+            inst.cut_complete_traces(immediate=immediate)
+
+
+def _flush_all(app):
+    for ing in app.ingesters.values():
+        for inst in list(ing.instances.values()):
+            inst.cut_block_if_ready(immediate=True)
+            inst.complete_and_flush()
+
+
+class TestRegistration:
+    def test_register_list_delete(self, tmp_path):
+        app = _mk_app(tmp_path)
+        try:
+            doc = app.standing_register({"q": RATE_Q, "step": 60})
+            assert doc["id"].startswith("sq-")
+            assert doc["window"] == app.cfg.standing.default_window_s
+            assert [d["id"] for d in app.standing_list()] == [doc["id"]]
+            app.standing_delete(doc["id"])
+            assert app.standing_list() == []
+        finally:
+            app.shutdown()
+
+    def test_bad_query_is_client_error(self, tmp_path):
+        from tempo_tpu.traceql import ParseError
+
+        app = _mk_app(tmp_path)
+        try:
+            with pytest.raises(ParseError):
+                app.standing_register({"q": "{ nonsense ===", "step": 60})
+            with pytest.raises(ParseError):
+                # not a metrics pipeline
+                app.standing_register({"q": "{}", "step": 60})
+            with pytest.raises(ValueError):
+                app.standing_register({"q": RATE_Q, "step": 0})
+        finally:
+            app.shutdown()
+
+    def test_per_tenant_cap(self, tmp_path):
+        app = App(AppConfig(
+            db=DBConfig(backend="local", backend_path=str(tmp_path / "b"),
+                        wal_path=str(tmp_path / "w")),
+            generator_enabled=False,
+            standing=StandingConfig(max_queries_per_tenant=2),
+        ))
+        try:
+            app.standing_register({"q": RATE_Q, "step": 60})
+            app.standing_register({"q": HIST_Q, "step": 60})
+            with pytest.raises(resource.ResourceExhausted):
+                app.standing_register({"q": RATE_Q, "step": 30})
+        finally:
+            app.shutdown()
+
+    def test_limits_override_wins(self):
+        eng = StandingEngine(StandingConfig(max_queries_per_tenant=1))
+
+        class Ov:
+            def for_tenant(self, t):
+                return Limits(max_standing_queries=3)
+
+        eng.overrides = Ov()
+        for i in range(3):
+            eng.register("t", RATE_Q, 60)
+        with pytest.raises(resource.ResourceExhausted):
+            eng.register("t", RATE_Q, 60)
+
+    def test_tenant_isolation(self, tmp_path):
+        from tempo_tpu.standing import UnknownStandingQuery
+
+        app = _mk_app(tmp_path, multitenancy_enabled=True)
+        try:
+            doc = app.standing_register({"q": RATE_Q, "step": 60}, org_id="a")
+            assert app.standing_list(org_id="b") == []
+            with pytest.raises(UnknownStandingQuery):
+                app.standing_state(doc["id"], org_id="b")
+        finally:
+            app.shutdown()
+
+
+class TestFoldExactness:
+    """At every cut boundary the standing read equals a from-scratch
+    query_range over the same window (the acceptance invariant)."""
+
+    @pytest.mark.parametrize("n_ingesters", [1, 2, 4])
+    def test_matches_query_range_across_cut_boundaries(self, tmp_path, n_ingesters):
+        app = _mk_app(tmp_path, n_ingesters=n_ingesters)
+        try:
+            base = _aligned_base()
+            doc = app.standing_register({"q": RATE_Q, "step": 60, "window": 3600})
+            start, end = base - 60, base + 240
+            for wave in range(3):
+                traces = synth.make_traces(
+                    8, seed=100 + wave, spans_per_trace=4,
+                    base_time_ns=(base + wave * 60) * 10**9)
+                app.push_traces(traces)
+                # boundary 1: pre-cut (tail-only for this wave)
+                assert _vals(app.standing_read(doc["id"], start_s=start, end_s=end)) \
+                    == _vals(app.query_range(RATE_Q, start, end, 60))
+                _cut_all(app)
+                # boundary 2: post-cut (accumulator holds the delta)
+                assert _vals(app.standing_read(doc["id"], start_s=start, end_s=end)) \
+                    == _vals(app.query_range(RATE_Q, start, end, 60))
+                _flush_all(app)
+                app.db.poll_now()
+                # boundary 3: post-flush+poll
+                assert _vals(app.standing_read(doc["id"], start_s=start, end_s=end)) \
+                    == _vals(app.query_range(RATE_Q, start, end, 60))
+        finally:
+            app.shutdown()
+
+    def test_device_and_host_fold_arms_agree(self, tmp_path, monkeypatch):
+        base = _aligned_base()
+        mats = {}
+        for arm, flag in (("host", "0"), ("device", "1")):
+            monkeypatch.setenv("TEMPO_TPU_METRICS_DEVICE", flag)
+            app = _mk_app(tmp_path / arm)
+            try:
+                doc = app.standing_register({"q": RATE_Q, "step": 60,
+                                             "window": 3600})
+                app.push_traces(synth.make_traces(
+                    10, seed=5, spans_per_trace=4, base_time_ns=base * 10**9))
+                _cut_all(app)
+                mats[arm] = _vals(app.standing_read(
+                    doc["id"], start_s=base - 60, end_s=base + 120))
+            finally:
+                app.shutdown()
+        assert mats["host"] == mats["device"]
+
+    def test_histogram_query_folds(self, tmp_path):
+        app = _mk_app(tmp_path)
+        try:
+            base = _aligned_base()
+            doc = app.standing_register({"q": HIST_Q, "step": 60, "window": 3600})
+            app.push_traces(synth.make_traces(
+                6, seed=9, spans_per_trace=5, base_time_ns=base * 10**9))
+            _cut_all(app)
+            start, end = base - 60, base + 120
+            assert _vals(app.standing_read(doc["id"], start_s=start, end_s=end)) \
+                == _vals(app.query_range(HIST_Q, start, end, 60))
+        finally:
+            app.shutdown()
+
+    def test_crash_restart_rebuild_with_faults_armed(self, tmp_path, monkeypatch):
+        base = _aligned_base()
+        app = _mk_app(tmp_path)
+        doc = app.standing_register({"q": RATE_Q, "step": 60, "window": 3600})
+        app.push_traces(synth.make_traces(
+            12, seed=3, spans_per_trace=4, base_time_ns=base * 10**9))
+        _cut_all(app)
+        # flush SOME data to the backend, keep some in the WAL, then
+        # "crash": snapshot exists (registration), WAL dirs survive
+        _flush_all(app)
+        app.push_traces(synth.make_traces(
+            5, seed=4, spans_per_trace=4, base_time_ns=(base + 60) * 10**9))
+        _cut_all(app)  # second wave stays WAL-only
+        app.standing.snapshot()
+        start, end = base - 60, base + 180
+        expect = _vals(app.query_range(RATE_Q, start, end, 60))
+        for ing in app.ingesters.values():
+            ing.stop(flush=False)  # crash: no final flush
+        # restart behind a fault-injecting backend: the rebuild's block
+        # reads must converge through per-op retries
+        monkeypatch.setenv("TEMPO_TPU_FAULTS", "read=0.05,seed=11")
+        app2 = _mk_app(tmp_path)
+        try:
+            got = app2.standing_read(doc["id"], start_s=start, end_s=end)
+            assert _vals(got) == expect
+            st = app2.standing_state(doc["id"])
+            assert st["stats"]["rebuilds"] >= 1
+            assert not st["stats"]["dirty"]
+        finally:
+            app2.shutdown()
+
+    def test_replayed_wal_segment_not_double_folded(self, tmp_path):
+        """A cut whose fold lands after a rebuild replayed its WAL
+        segment must be dropped (the rebuilt_segs dedupe)."""
+        app = _mk_app(tmp_path)
+        try:
+            base = _aligned_base()
+            doc = app.standing_register({"q": RATE_Q, "step": 60, "window": 3600})
+            app.push_traces(synth.make_traces(
+                6, seed=21, spans_per_trace=3, base_time_ns=base * 10**9))
+            _cut_all(app)
+            q = app.standing.get("single-tenant", doc["id"])
+            # rebuild replays the WAL segment the cut just appended...
+            app.standing.rebuild(q)
+            assert q.rebuilt_segs, "rebuild saw no WAL segments"
+            seg_key = next(iter(q.rebuilt_segs))
+            before = _vals(app.standing_read(doc["id"], start_s=base - 60,
+                                             end_s=base + 120))
+            # ...so a late in-flight fold of that same segment is a no-op
+            batch = app.ingesters["ingester-0"].standing_wal_batches(
+                "single-tenant")[0][1]
+            app.standing.fold("single-tenant", batch, seg_key=seg_key)
+            after = _vals(app.standing_read(doc["id"], start_s=base - 60,
+                                            end_s=base + 120))
+            assert before == after
+        finally:
+            app.shutdown()
+
+
+class TestHandoffDip:
+    def test_standing_read_immune_to_blocklist_gap(self, tmp_path):
+        """Root fix for the PR 11 known transient: after an ingester
+        hands a block off, query_range can miss its spans until the next
+        blocklist poll; the standing accumulator already holds them."""
+        app = _mk_app(tmp_path)
+        try:
+            base = _aligned_base()
+            doc = app.standing_register({"q": RATE_Q, "step": 60, "window": 3600})
+            app.standing_read(doc["id"])  # clear the registration
+            # backfill so the dip check below exercises the ACCUMULATOR,
+            # not a rebuild
+            app.push_traces(synth.make_traces(
+                10, seed=13, spans_per_trace=4, base_time_ns=base * 10**9))
+            _cut_all(app)
+            start, end = base - 60, base + 120
+            expect = _vals(app.query_range(RATE_Q, start, end, 60))
+            _flush_all(app)
+            # simulate the remote-querier poll gap: the flushed block is
+            # in the backend but NOT in the (stale) blocklist view
+            app.db.blocklist.apply_poll_results({}, {})
+            dipped = _vals(app.query_range(RATE_Q, start, end, 60))
+            assert dipped != expect, "fixture failed to open the poll gap"
+            standing = _vals(app.standing_read(doc["id"], start_s=start, end_s=end))
+            assert standing == expect, "standing read dipped during handoff"
+            app.db.poll_now()  # the gap heals at the next poll
+            assert _vals(app.query_range(RATE_Q, start, end, 60)) == expect
+        finally:
+            app.shutdown()
+
+
+class TestStepPartials:
+    @pytest.fixture()
+    def store(self, tmp_path):
+        backend = TypedBackend(LocalBackend(str(tmp_path)))
+        enc = from_version("vtpu1")
+        cfg = BlockConfig(row_group_spans=1024)
+        metas = [
+            enc.create_block([synth.make_batch(400, 6, seed=70 + i)], "t",
+                             backend, cfg)
+            for i in range(3)
+        ]
+        return backend, enc, cfg, metas
+
+    def _span_ref(self, plan, store):
+        from tempo_tpu.metrics_engine import HostAccumulator, evaluate_block
+
+        backend, enc, cfg, metas = store
+        acc = HostAccumulator(plan)
+        span_bytes = 0
+        for m in metas:
+            blk = enc.open_block(m, backend, cfg)
+            evaluate_block(plan, blk, acc)
+            span_bytes += blk.bytes_read
+        return acc, span_bytes
+
+    @pytest.mark.parametrize("q,step", [
+        (RATE_Q, 60), (RATE_Q, 120),
+        ("{} | count_over_time() by (resource.service.name)", 60),
+        (HIST_Q, 60),
+        ("{} | quantile_over_time(duration, 0.5, 0.99)", 60),
+    ])
+    def test_partial_reads_bit_identical_and_cheap(self, q, step, store):
+        from tempo_tpu.metrics_engine import HostAccumulator, compile_metrics_plan
+
+        backend, enc, cfg, metas = store
+        base = (1_700_000_000 // 120) * 120
+        plan = compile_metrics_plan(q, base - step, base + 2 * step, step)
+        rule = sp_rules.match_rule(plan, sp_rules.block_rules(cfg))
+        assert rule is not None
+        ref, span_bytes = self._span_ref(plan, store)
+        acc = HostAccumulator(plan)
+        partial_bytes = 0
+        for m in metas:
+            blk = enc.open_block(m, backend, cfg)
+            sp_rules.evaluate_block_hybrid(plan, rule, blk, acc)
+            partial_bytes += blk.bytes_read
+        assert (acc.merged_counts() == ref.counts).all()
+        assert acc.stats["inspectedSpans"] == 0, "span columns were scanned"
+        assert acc.stats["partialRowGroups"] > 0
+        # "span-column fetch bytes ~ 0": only index/partial pages read
+        assert partial_bytes < span_bytes
+
+    def test_no_match_for_filtered_or_unaligned_plans(self, store):
+        from tempo_tpu.metrics_engine import compile_metrics_plan
+
+        _, _, cfg, _ = store
+        rules = sp_rules.block_rules(cfg)
+        base = (1_700_000_000 // 60) * 60
+        filtered = compile_metrics_plan(
+            "{ span.http.status_code >= 500 } | rate() by (resource.service.name)",
+            base, base + 120, 60)
+        assert sp_rules.match_rule(filtered, rules) is None
+        unaligned = compile_metrics_plan(RATE_Q, base + 1, base + 121, 60)
+        assert sp_rules.match_rule(unaligned, rules) is None
+        coarse_grid = compile_metrics_plan(RATE_Q, base, base + 180, 90)
+        assert sp_rules.match_rule(coarse_grid, rules) is None  # 90 % 60 != 0
+        exemplars = compile_metrics_plan(RATE_Q, base, base + 120, 60,
+                                         exemplars=2)
+        assert sp_rules.match_rule(exemplars, rules) is None
+
+    def test_partials_survive_compaction_bit_exact(self, tmp_path):
+        """Compacted fixtures: partial reads == span reads after both
+        relocation (disjoint inputs copy pages verbatim) and merge
+        clusters (decoded rows recompute partials post-dedupe)."""
+        from tempo_tpu.db import TempoDB
+        from tempo_tpu.metrics_engine import (
+            HostAccumulator,
+            compile_metrics_plan,
+            evaluate_block,
+        )
+
+        db = TempoDB(DBConfig(backend="local", backend_path=str(tmp_path / "b"),
+                              wal_path=str(tmp_path / "w"),
+                              block=BlockConfig(row_group_spans=1024)))
+        # two disjoint batches (relocation) + one overlapping pair (merge)
+        b1 = synth.make_batch(300, 4, seed=1)
+        b2 = synth.make_batch(300, 4, seed=2)
+        db.write_batch("t", b1)
+        db.write_batch("t", b2)
+        db.write_batch("t", b2)  # duplicate block: forces a merge cluster
+        db.poll_now()
+        assert db.compact_once("t", max_jobs=1) >= 1
+        db.poll_now()
+        metas = db.blocklist.metas("t")
+        assert any(m.compaction_level > 0 for m in metas)
+        enc = from_version("vtpu1")
+        base = (1_700_000_000 // 60) * 60
+        plan = compile_metrics_plan(RATE_Q, base - 60, base + 120, 60)
+        rule = sp_rules.match_rule(plan, sp_rules.block_rules(db.cfg.block))
+        acc_p = HostAccumulator(plan)
+        acc_s = HostAccumulator(plan)
+        for m in metas:
+            sp_rules.evaluate_block_hybrid(
+                plan, rule, enc.open_block(m, db.backend, db.cfg.block), acc_p)
+            evaluate_block(
+                plan, enc.open_block(m, db.backend, db.cfg.block), acc_s)
+        assert acc_p.stats["partialRowGroups"] > 0
+        assert acc_p.stats["inspectedSpans"] == 0
+        assert (acc_p.merged_counts() == acc_s.merged_counts()).all()
+        db.shutdown()
+
+    def test_legacy_row_groups_fall_back(self, tmp_path, monkeypatch):
+        """Blocks written before the tier (or with it disabled) read
+        through the span path inside the hybrid evaluator."""
+        from tempo_tpu.metrics_engine import HostAccumulator, compile_metrics_plan
+
+        backend = TypedBackend(LocalBackend(str(tmp_path)))
+        enc = from_version("vtpu1")
+        cfg = BlockConfig(row_group_spans=1024)
+        monkeypatch.setenv("TEMPO_TPU_STEP_PARTIALS", "0")
+        legacy = enc.create_block([synth.make_batch(200, 4, seed=5)], "t",
+                                  backend, cfg)
+        monkeypatch.delenv("TEMPO_TPU_STEP_PARTIALS")
+        blk = enc.open_block(legacy, backend, cfg)
+        assert not any(rg.partials for rg in blk.index().row_groups)
+        base = (1_700_000_000 // 60) * 60
+        plan = compile_metrics_plan(RATE_Q, base - 60, base + 120, 60)
+        rule = sp_rules.match_rule(plan, sp_rules.block_rules(cfg))
+        acc = HostAccumulator(plan)
+        sp_rules.evaluate_block_hybrid(plan, rule, blk, acc)
+        ref = HostAccumulator(plan)
+        from tempo_tpu.metrics_engine import evaluate_block
+
+        evaluate_block(plan, enc.open_block(legacy, backend, cfg), ref)
+        assert (acc.merged_counts() == ref.counts).all()
+        assert acc.stats.get("partialRowGroups", 0) == 0
+        assert acc.stats["inspectedSpans"] > 0
+
+    def test_querier_query_range_uses_partials(self, tmp_path):
+        """End to end through the app: a matching query_range reads
+        partials (stats carry partialRowGroups; span scan stays 0)."""
+        app = _mk_app(tmp_path)
+        try:
+            base = _aligned_base()
+            app.push_traces(synth.make_traces(
+                10, seed=17, spans_per_trace=4, base_time_ns=base * 10**9))
+            app.sweep_all(immediate=True)
+            app.db.poll_now()
+            # time-travel the blocks out of the recent window so ONLY
+            # block jobs serve (live/WAL is drained already)
+            mat = app.query_range(RATE_Q, base - 60, base + 120, 60)
+            assert mat["stats"].get("partialRowGroups", 0) > 0
+        finally:
+            app.shutdown()
+
+
+class TestGovernorAndUsage:
+    def test_fold_sheds_at_pressure_and_rebuild_heals(self, tmp_path):
+        app = _mk_app(tmp_path)
+        try:
+            base = _aligned_base()
+            doc = app.standing_register({"q": RATE_Q, "step": 60, "window": 3600})
+            gov = app.standing.governor = resource.ResourceGovernor(
+                resource.ResourceConfig())
+            gov.pool("live_traces").limit = 100
+            gov.pool("live_traces").add(95)  # over the soft watermark
+            assert gov.level() >= resource.LEVEL_PRESSURE
+            app.push_traces(synth.make_traces(
+                6, seed=31, spans_per_trace=3, base_time_ns=base * 10**9))
+            _cut_all(app)
+            st = app.standing_state(doc["id"])
+            assert st["stats"]["sheds"] == 1
+            assert st["stats"]["folds"] == 0
+            assert st["stats"]["dirty"]
+            # pressure clears -> the next read rebuilds exactly
+            gov.pool("live_traces").sub(95)
+            got = app.standing_read(doc["id"], start_s=base - 60, end_s=base + 120)
+            assert _vals(got) == _vals(
+                app.query_range(RATE_Q, base - 60, base + 120, 60))
+            assert not app.standing_state(doc["id"])["stats"]["dirty"]
+        finally:
+            app.shutdown()
+
+    def test_usage_metered_under_kind_standing(self, tmp_path):
+        app = _mk_app(tmp_path)
+        try:
+            base = _aligned_base()
+            app.standing_register({"q": RATE_Q, "step": 60, "window": 3600})
+            app.push_traces(synth.make_traces(
+                6, seed=33, spans_per_trace=3, base_time_ns=base * 10**9))
+            _cut_all(app)
+            row = usage.ACCOUNTANT.snapshot("single-tenant")[
+                "single-tenant"].get("standing", {})
+            assert row.get("inspected_bytes", 0) > 0
+        finally:
+            app.shutdown()
+
+    def test_fold_spans_equals_cut_delta(self, tmp_path):
+        """The O(delta) bookkeeping the loadtest gate reads: per-query
+        folded spans == the tenant's cut-delta spans (plus sheds)."""
+        app = _mk_app(tmp_path)
+        try:
+            base = _aligned_base()
+            doc = app.standing_register({"q": RATE_Q, "step": 60, "window": 3600})
+            for wave in range(3):
+                app.push_traces(synth.make_traces(
+                    4, seed=50 + wave, spans_per_trace=3,
+                    base_time_ns=base * 10**9))
+                _cut_all(app)
+            st = app.standing_state(doc["id"])["stats"]
+            cut = app.standing.cut_spans["single-tenant"]
+            assert cut > 0
+            assert st["spansFolded"] + st["spansShed"] == cut
+        finally:
+            app.shutdown()
+
+
+class TestAlerting:
+    def test_threshold_fires_and_clears(self, tmp_path):
+        from tempo_tpu.standing.engine import alert_firing_gauge
+
+        app = _mk_app(tmp_path)
+        try:
+            now = int(time.time())
+            doc = app.standing_register({
+                "q": RATE_Q, "step": 60, "window": 3600,
+                "alert": {"op": ">", "value": 0.0},
+            })
+            # spans in the latest COMPLETE bin (now//step - 1)
+            bin_start = (now // 60 - 1) * 60
+            app.push_traces(synth.make_traces(
+                5, seed=41, spans_per_trace=4,
+                base_time_ns=bin_start * 10**9))
+            _cut_all(app)
+            st = app.standing_state(doc["id"])
+            assert st["firing"], st
+            assert any(v == 1 for labels, v in alert_firing_gauge.series()
+                       if labels.get("query_id") == doc["id"])
+        finally:
+            app.shutdown()
+
+
+class TestReviewRegressions:
+    """Pinned fixes from the PR's review pass."""
+
+    def test_alert_clears_without_traffic(self, tmp_path):
+        """A firing alert must decay once its bin empties even with zero
+        ingest (no folds) — state reads and /metrics scrapes re-evaluate."""
+        from tempo_tpu.standing.engine import alert_firing_gauge
+
+        app = _mk_app(tmp_path)
+        try:
+            now = int(time.time())
+            doc = app.standing_register({
+                "q": RATE_Q, "step": 60, "window": 3600,
+                "alert": {"op": ">", "value": 0.0},
+            })
+            bin_start = (now // 60 - 1) * 60
+            app.push_traces(synth.make_traces(
+                4, seed=81, spans_per_trace=3, base_time_ns=bin_start * 10**9))
+            _cut_all(app)
+            assert app.standing_state(doc["id"])["firing"]
+            q = app.standing.get("single-tenant", doc["id"])
+            # two steps later the latest complete bin is empty: the
+            # re-evaluation (state read / scrape collector) must clear it
+            with q.lock:
+                app.standing._eval_alert(q, now + 180)
+            assert not any(v for v in q.firing.values())
+            assert all(v == 0 for labels, v in alert_firing_gauge.series()
+                       if labels.get("query_id") == doc["id"])
+        finally:
+            app.shutdown()
+
+    def test_registration_backfills_preexisting_data(self, tmp_path):
+        """A query registered over a store that already holds the window
+        must serve it (first read rebuilds), not silent zeros."""
+        app = _mk_app(tmp_path)
+        try:
+            base = _aligned_base()
+            app.push_traces(synth.make_traces(
+                8, seed=87, spans_per_trace=4, base_time_ns=base * 10**9))
+            app.sweep_all(immediate=True)
+            app.db.poll_now()
+            doc = app.standing_register({"q": RATE_Q, "step": 60, "window": 3600})
+            got = app.standing_read(doc["id"], start_s=base - 60, end_s=base + 120)
+            expect = app.query_range(RATE_Q, base - 60, base + 120, 60)
+            assert _vals(got) == _vals(expect)
+            assert got["stats"].get("degraded") is None
+            assert any(float(v) > 0 for r in got["result"]
+                       for _, v in r["values"])
+        finally:
+            app.shutdown()
+
+    def test_fold_usage_charged_once_per_cut(self, tmp_path):
+        """The tempodb inspected counter tracks the cut, not cut x
+        registered queries (it is a storage/live-scan signal, and the
+        PR 10 rule ties the cost vector to the same statement)."""
+        from tempo_tpu.encoding.vtpu.block import inspected_bytes_total
+
+        app = _mk_app(tmp_path)
+        try:
+            base = _aligned_base()
+            for q in (RATE_Q, HIST_Q, "{} | count_over_time()"):
+                app.standing_register({"q": q, "step": 60, "window": 3600})
+            def counter():
+                return sum(v for labels, v in inspected_bytes_total.series()
+                           if labels.get("tenant") == "single-tenant")
+            before = counter()
+            app.push_traces(synth.make_traces(
+                5, seed=88, spans_per_trace=3, base_time_ns=base * 10**9))
+            inst = app.ingesters["ingester-0"].instance("single-tenant")
+            batch_bytes = sum(lt.byte_count for lt in inst.live.values())
+            _cut_all(app)
+            charged = counter() - before
+            # one cut's bytes, NOT x3 for the three registered queries
+            assert 0 < charged <= batch_bytes * 1.5, (charged, batch_bytes)
+        finally:
+            app.shutdown()
+
+    def test_fold_failure_marks_query_dirty(self, tmp_path, monkeypatch):
+        """An eval failure for one query must mark IT dirty (rebuild
+        heals) without starving sibling queries or the cut path."""
+        app = _mk_app(tmp_path)
+        try:
+            base = _aligned_base()
+            bad = app.standing_register({"q": RATE_Q, "step": 60, "window": 3600})
+            good = app.standing_register({"q": HIST_Q, "step": 60, "window": 3600})
+            for d in (bad, good):  # clear the registration-backfill dirty
+                app.standing_read(d["id"])
+            orig = app.standing._fold_one
+
+            def boom(q, batch, d):
+                if q.id == bad["id"]:
+                    raise RuntimeError("injected fold failure")
+                return orig(q, batch, d)
+
+            monkeypatch.setattr(app.standing, "_fold_one", boom)
+            app.push_traces(synth.make_traces(
+                5, seed=83, spans_per_trace=3, base_time_ns=base * 10**9))
+            _cut_all(app)  # must not raise
+            assert app.standing_state(bad["id"])["stats"]["dirty"]
+            g = app.standing_state(good["id"])["stats"]
+            assert g["folds"] == 1 and not g["dirty"]
+            # the dirty query heals through the read-path rebuild
+            monkeypatch.setattr(app.standing, "_fold_one", orig)
+            _flush_all(app)
+            got = app.standing_read(bad["id"], start_s=base - 60, end_s=base + 120)
+            assert _vals(got) == _vals(
+                app.query_range(RATE_Q, base - 60, base + 120, 60))
+        finally:
+            app.shutdown()
+
+    def test_wal_seg_keys_survive_corrupt_segment(self, tmp_path):
+        """Fold keys are on-disk segment numbers; a corrupt earlier
+        segment must not shift later segments onto wrong keys (which
+        would defeat the rebuild/fold dedupe and double-count)."""
+        import os
+
+        app = _mk_app(tmp_path)
+        try:
+            base = _aligned_base()
+            app.standing_register({"q": RATE_Q, "step": 60, "window": 3600})
+            for wave in range(2):
+                app.push_traces(synth.make_traces(
+                    3, seed=90 + wave, spans_per_trace=3,
+                    base_time_ns=base * 10**9))
+                _cut_all(app)
+            inst = app.ingesters["ingester-0"].instance("single-tenant")
+            segs = sorted(
+                f for f in os.listdir(inst.head.path) if f.endswith(".seg"))
+            assert len(segs) == 2
+            with open(os.path.join(inst.head.path, segs[0]), "wb") as f:
+                f.write(b"garbage")
+            keyed = app.ingesters["ingester-0"].standing_wal_batches(
+                "single-tenant")
+            assert [k for k, _ in keyed] == [f"{inst.head.block_id}:1"]
+        finally:
+            app.shutdown()
+
+
+class TestHTTPEndpoints:
+    def test_lifecycle_over_http(self, tmp_path):
+        from tempo_tpu.api.server import TempoServer
+
+        app = _mk_app(tmp_path)
+        srv = TempoServer(app).start()
+        try:
+            base = _aligned_base()
+
+            def req(method, path, body=None):
+                r = urllib.request.Request(
+                    srv.url + path, method=method,
+                    data=json.dumps(body).encode() if body is not None else None,
+                    headers={"Content-Type": "application/json"})
+                try:
+                    with urllib.request.urlopen(r, timeout=10) as resp:
+                        raw = resp.read()
+                        return resp.status, json.loads(raw) if raw else None
+                except urllib.error.HTTPError as e:
+                    return e.code, None
+
+            code, doc = req("POST", "/api/metrics/standing",
+                            {"q": RATE_Q, "step": 60, "window": 3600})
+            assert code == 200 and doc["id"].startswith("sq-")
+            qid = doc["id"]
+            code, listing = req("GET", "/api/metrics/standing")
+            assert code == 200 and len(listing["queries"]) == 1
+            app.push_traces(synth.make_traces(
+                5, seed=61, spans_per_trace=3, base_time_ns=base * 10**9))
+            _cut_all(app)
+            code, mat = req("GET", f"/api/metrics/standing/{qid}"
+                                   f"?start={base - 60}&end={base + 120}&step=60")
+            assert code == 200 and mat["data"]["resultType"] == "matrix"
+            assert mat["data"]["result"], "no series served"
+            assert mat["metrics"].get("standing") is True
+            code, state = req("GET", f"/api/metrics/standing/{qid}/state")
+            assert code == 200 and state["stats"]["folds"] == 1
+            assert req("GET", "/api/metrics/standing/sq-nope")[0] == 404
+            assert req("POST", "/api/metrics/standing",
+                       {"q": "{ bad ===", "step": 60})[0] == 400
+            code, _ = req("DELETE", f"/api/metrics/standing/{qid}")
+            assert code == 204
+            assert req("GET", f"/api/metrics/standing/{qid}/state")[0] == 404
+        finally:
+            srv.stop()
+            app.shutdown()
+
+
+class TestCheckConfig:
+    def test_standing_warnings(self):
+        from tempo_tpu.config import check_config, parse_config
+
+        cfg = parse_config("""
+multitenancy_enabled: true
+ingester:
+  max_block_duration_s: 30
+""")
+        warns = "\n".join(check_config(cfg))
+        assert "standing.max_queries_per_tenant" in warns
+        assert "coarser than ingester.max_block_duration_s" in warns
+
+    def test_series_ceiling_warning(self):
+        from tempo_tpu.config import check_config, parse_config
+
+        cfg = parse_config("""
+storage:
+  trace:
+    block:
+      step_partial_rules:
+        - ["huge", "{} | histogram_over_time(duration)", 1, 4096]
+""")
+        warns = "\n".join(check_config(cfg))
+        assert "exceeds plan.MAX_SLOTS" in warns
+
+    def test_quiet_by_default(self):
+        from tempo_tpu.config import check_config, parse_config
+
+        warns = check_config(parse_config(""))
+        assert not [w for w in warns if "standing" in w or "step-partial" in w]
+
+
+class TestSnapshot:
+    def test_snapshot_restore_roundtrip(self, tmp_path):
+        app = _mk_app(tmp_path)
+        base = _aligned_base()
+        doc = app.standing_register({
+            "q": RATE_Q, "step": 60, "window": 3600,
+            "alert": {"op": ">", "value": 5.0},
+        })
+        app.push_traces(synth.make_traces(
+            5, seed=71, spans_per_trace=3, base_time_ns=base * 10**9))
+        _cut_all(app)
+        app.shutdown()  # final snapshot
+        app2 = _mk_app(tmp_path)
+        try:
+            docs = app2.standing_list()
+            assert len(docs) == 1
+            assert docs[0]["alert"] == {"op": ">", "value": 5.0}
+            assert docs[0]["id"] == doc["id"]
+        finally:
+            app2.shutdown()
+
+
+class TestVultureNoteParity:
+    def test_vulture_docstring_names_standing_immunity(self):
+        """Satellite: the PR 11 known-transient note must point at the
+        standing-query fix rather than asking operators to tolerate it."""
+        import tempo_tpu.vulture as v
+
+        assert "standing" in (v.__doc__ or "").lower()
+
+
+class TestBinsMath:
+    """Pure-function edges of the partial tier."""
+
+    def test_batch_partial_declines_wild_timestamps(self):
+        b = synth.make_batch(10, 2, seed=1)
+        b.cols["start_unix_nano"] = b.cols["start_unix_nano"].copy()
+        b.cols["start_unix_nano"][0] = np.uint64(2**62)  # ~year 148k
+        rule = sp_rules.StepRule("r", RATE_Q, 60, 512)
+        assert sp_rules.batch_partial(b, b.dictionary, rule) is None
+
+    def test_batch_partial_declines_series_overflow(self):
+        b = synth.make_batch(64, 2, seed=2)
+        rule = sp_rules.StepRule("r", RATE_Q, 60, 1)  # ceiling 1 < services
+        assert sp_rules.batch_partial(b, b.dictionary, rule) is None
+
+    def test_rule_identity_mismatch_treated_as_absent(self, tmp_path):
+        backend = TypedBackend(LocalBackend(str(tmp_path)))
+        enc = from_version("vtpu1")
+        cfg = BlockConfig(row_group_spans=1024)
+        meta = enc.create_block([synth.make_batch(100, 3, seed=3)], "t",
+                                backend, cfg)
+        blk = enc.open_block(meta, backend, cfg)
+        rg = blk.index().row_groups[0]
+        stale = sp_rules.StepRule("rate_by_service", RATE_Q, 30, 512)  # step moved
+        assert not sp_rules.rg_has_partial(rg, stale)
+        good = sp_rules.block_rules(cfg)[0]
+        assert sp_rules.rg_has_partial(rg, good)

@@ -44,8 +44,10 @@ import socket
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -341,6 +343,154 @@ def receiver_sweep(dist_url: str, query_url: str, grpc_port: int = 0) -> dict:
     for proto in pending:
         results[proto] = "error: not queryable within 30s"
     return results
+
+
+# ---------------------------------------------------------------------------
+# --standing arm: registered queries folded per cut, gated on O(delta),
+# zero read dips during handoff, and usage exactness for kind "standing"
+# ---------------------------------------------------------------------------
+
+
+def _http_json(url, method="GET", body=None, tenant=None, timeout=15):
+    headers = {"Content-Type": "application/json"}
+    if tenant:
+        headers["X-Scope-OrgID"] = tenant
+    req = urllib.request.Request(
+        url, method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        raw = r.read()
+        return json.loads(raw) if raw else None
+
+
+class StandingArm:
+    """Registers N standing queries across tenants on the ingester
+    processes BEFORE the load, samples each one's pinned-window total
+    during the run (a dip = a decrease of a cumulative count), and
+    gates at drain on:
+      (i) O(delta): per-query spansFolded+spansShed == the process's
+          cut-delta spans for that tenant (read from /status/standing),
+     (ii) zero standing-read dips across every cut/flush/handoff the
+          mixed workload provoked,
+    (iii) usage exactness: kind "standing" carries positive per-tenant
+          cost wherever folds ran.
+    """
+
+    def __init__(self, ingester_urls: list, n: int, tenants: list | None):
+        self.regs: list[dict] = []  # {url, id, tenant}
+        self.dips = 0
+        self.samples = 0
+        self._last_total: dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread = None
+        now = int(time.time())
+        self.win_start = (now // 60) * 60 - 60
+        self.win_end = self.win_start + 3600
+        for i in range(n):
+            url = ingester_urls[i % len(ingester_urls)]
+            tenant = tenants[i % len(tenants)] if tenants else None
+            # window far beyond any soak: the accumulator prunes bins
+            # older than its window, and a pruned bin inside the PINNED
+            # sampling window would read as a dip that never happened
+            doc = _http_json(
+                f"{url}/api/metrics/standing", method="POST",
+                body={"q": "{} | count_over_time()", "step": 60,
+                      "window": 7 * 86400}, tenant=tenant)
+            self.regs.append({"url": url, "id": doc["id"], "tenant": tenant})
+
+    def _total(self, reg) -> float | None:
+        qs = urllib.parse.urlencode({
+            "start": self.win_start, "end": self.win_end, "step": 60})
+        try:
+            doc = _http_json(f"{reg['url']}/api/metrics/standing/"
+                             f"{reg['id']}?{qs}", tenant=reg["tenant"])
+        except (urllib.error.URLError, OSError, ValueError):
+            return None
+        return sum(
+            float(v) for series in doc["data"]["result"]
+            for _, v in series.get("values", []))
+
+    def _run(self):
+        while not self._stop.wait(0.5):
+            for reg in self.regs:
+                total = self._total(reg)
+                if total is None:
+                    continue
+                self.samples += 1
+                last = self._last_total.get(reg["id"])
+                # cumulative count over a pinned window: any decrease is
+                # a read dip (the PR 11 handoff transient, fixed for
+                # standing reads)
+                if last is not None and total < last - 1e-9:
+                    self.dips += 1
+                self._last_total[reg["id"]] = total
+
+    def start(self) -> "StandingArm":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def summary(self) -> dict:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        # one final post-drain sample per query (folds have quiesced)
+        for reg in self.regs:
+            total = self._total(reg)
+            last = self._last_total.get(reg["id"])
+            if total is not None and last is not None and total < last - 1e-9:
+                self.dips += 1
+        # gate (i): O(delta) — per-query folded spans == the engine's
+        # cut-delta spans for that tenant on the same process
+        odelta_ok, odelta = True, []
+        by_url_status: dict[str, dict] = {}
+        for reg in self.regs:
+            try:
+                st = _http_json(f"{reg['url']}/api/metrics/standing/"
+                                f"{reg['id']}/state", tenant=reg["tenant"])
+                if reg["url"] not in by_url_status:
+                    by_url_status[reg["url"]] = _http_json(
+                        f"{reg['url']}/status/standing")
+                cut = by_url_status[reg["url"]]["cutSpans"].get(
+                    reg["tenant"] or "single-tenant", 0)
+                folded = st["stats"]["spansFolded"] + st["stats"]["spansShed"]
+                ok = folded == cut and st["stats"]["folds"] > 0
+                odelta_ok = odelta_ok and ok
+                odelta.append({"id": reg["id"], "url": reg["url"],
+                               "folded": folded, "cut": cut,
+                               "folds": st["stats"]["folds"], "ok": ok})
+            except (urllib.error.URLError, OSError, KeyError, ValueError) as e:
+                odelta_ok = False
+                odelta.append({"id": reg["id"], "url": reg["url"],
+                               "error": str(e)})
+        # gate (iii): usage exactness for kind "standing" on every
+        # ingester that folded
+        usage_ok = True
+        for url in {r["url"] for r in self.regs}:
+            try:
+                rep = _http_json(f"{url}/status/usage")
+                folded_here = any(o.get("folds", 0) > 0 and o.get("ok")
+                                  and o.get("url") == url for o in odelta)
+                if folded_here:
+                    rows = [
+                        kinds.get("standing", {}).get("inspected_bytes", 0)
+                        for kinds in (
+                            t["kinds"] for t in rep.get("tenants", {}).values())
+                    ]
+                    usage_ok = usage_ok and any(b > 0 for b in rows)
+            except (urllib.error.URLError, OSError, ValueError):
+                usage_ok = False
+        return {
+            "queries": len(self.regs),
+            "samples": self.samples,
+            "dips": self.dips,
+            "odelta": odelta,
+            "odelta_ok": odelta_ok,
+            "usage_ok": usage_ok,
+            "passed": bool(self.dips == 0 and odelta_ok and usage_ok
+                           and self.samples > 0),
+        }
 
 
 def query_range_probe(query_url: str, n: int = 10) -> dict:
@@ -959,6 +1109,12 @@ def main() -> int:
                     help="run the continuous-verification prober beside "
                          "the mixed workload and gate on read-after-write "
                          "correctness at drain + the freshness SLO")
+    ap.add_argument("--standing", type=int, default=0, metavar="N",
+                    help="register N standing queries across tenants on the "
+                         "ingesters before the load; gates on (i) per-eval "
+                         "inspected spans == cut delta (O(delta)), (ii) zero "
+                         "standing-read dips during handoff, (iii) usage "
+                         "exactness for kind 'standing'")
     ap.add_argument("--tenants", type=int, default=1,
                     help=">1 enables multi-tenant mode: the cluster boots "
                          "with multitenancy, every op carries one of N org "
@@ -1002,6 +1158,15 @@ def main() -> int:
         sweep_ok = all(v in ("ok", "skipped") for v in sweep.values()) if sweep else True
 
         rss = RSSSampler(procs).start() if procs else None
+        standing = None
+        if args.standing > 0:
+            ing_urls = [p.url for p in procs if p.name.startswith("ing")]
+            if not ing_urls:
+                ing_urls = [write_url]  # --url mode: single target
+            standing = StandingArm(ing_urls, args.standing, tenant_ids).start()
+            print(f"[loadtest] standing arm: {args.standing} queries "
+                  f"registered across {len(ing_urls)} ingester(s)",
+                  file=sys.stderr)
         vulture = None
         if args.vulture:
             vulture = start_vulture(write_url, query_url,
@@ -1019,6 +1184,13 @@ def main() -> int:
         loss = verify_acked(query_url, acked_ids)
         summary["acked_loss"] = loss
         print(f"[loadtest] acked-loss check: {loss}", file=sys.stderr)
+
+        standing_ok = True
+        if standing is not None:
+            summary["standing"] = standing.summary()
+            standing_ok = summary["standing"]["passed"]
+            print(f"[loadtest] standing gate: {summary['standing']}",
+                  file=sys.stderr)
 
         vulture_ok = True
         if vulture is not None:
@@ -1060,6 +1232,7 @@ def main() -> int:
             and sweep_ok
             and attribution_ok
             and vulture_ok
+            and standing_ok
             and device_ok
             and (rss is None or summary["rss"]["passed"])
         )
