@@ -762,6 +762,102 @@ def _standing_rep(reps: int = 3) -> dict:
         tmp.cleanup()
 
 
+def _hot_tier_rep(reps: int = 3) -> dict:
+    """Device-resident hot tier rep (BENCH_r06+, ISSUE 16): repeated
+    selective searches over the same blocks, `cold` arm (tier disabled:
+    every run pays fetch+decode) vs `resident` arm (the predicate pages
+    pinned on device in encoded form: the scan runs the fused device
+    decode over parked pages, zero payload movement). Interleaved with
+    paired per-rep ratios; each arm's stage waterfall rides the artifact
+    so the claim 'fetch+decode+transfer ~= 0 on the hot set' is
+    inspectable, not asserted blind. Admission is forced open here —
+    the POLICY (knee/min-ships) has its own tests; the rep measures the
+    serving economy."""
+    from tempo_tpu.backend import LocalBackend, TypedBackend
+    from tempo_tpu.encoding import from_version
+    from tempo_tpu.encoding.common import BlockConfig, SearchRequest
+    from tempo_tpu.encoding.vtpu import colcache
+    from tempo_tpu.encoding.vtpu.colcache import shared_cache
+    from tempo_tpu.util import devicetiming, stagetimings
+
+    enc = from_version("vtpu1")
+    tmp = tempfile.TemporaryDirectory(dir=_bench_dir())
+    try:
+        backend = TypedBackend(LocalBackend(tmp.name))
+        cfg = BlockConfig(row_group_spans=2048)
+        metas = _search_inputs(backend, cfg, n_blocks=6)
+        queries = {
+            "tag": SearchRequest(tags={"service": "needle-svc"}, limit=0),
+            "tag+duration": SearchRequest(tags={"service": "needle-svc"},
+                                          min_duration_ns=1, limit=0),
+        }
+
+        def run_once(req, waterfall: dict | None = None):
+            cache = shared_cache()
+            if cache is not None:
+                cache.clear()  # neither arm leans on warm host decode
+            hits = set()
+            t0 = time.perf_counter()
+            with stagetimings.request() as st:
+                for m in metas:
+                    r = enc.open_block(m, backend, cfg).search(req)
+                    hits.update(t.trace_id_hex for t in r.traces)
+            dt = time.perf_counter() - t0
+            if waterfall is not None:
+                waterfall.clear()
+                waterfall.update(st.to_wire())
+            return dt, hits
+
+        out = {}
+        old_tier = colcache._shared_device
+        try:
+            for qname, req in queries.items():
+                tier = colcache.DeviceTier(64 << 20, refresh_s=3600.0)
+                tier.should_admit = lambda page_keys: True
+                colcache._shared_device = tier
+                run_once(req)  # warm: admissions ship the payloads once
+                cold_t: list = []
+                hot_t: list = []
+                wf: dict = {"cold": {}, "resident": {}}
+                tx: dict = {"cold": [], "resident": [], "avoided_bytes": []}
+                hits_ref = None
+                for _ in range(reps):
+                    colcache._shared_device = None
+                    before = _transfer_totals()
+                    dt, hits_c = run_once(req, wf["cold"])
+                    cold_t.append(dt)
+                    tx["cold"].append(_transfer_delta(before))
+                    colcache._shared_device = tier
+                    before = _transfer_totals()
+                    a0 = devicetiming.avoided_total()
+                    dt, hits_r = run_once(req, wf["resident"])
+                    hot_t.append(dt)
+                    tx["resident"].append(_transfer_delta(before))
+                    tx["avoided_bytes"].append(
+                        int(devicetiming.avoided_total() - a0))
+                    if hits_c != hits_r:
+                        print(f"[bench] WARNING: hot_tier rep {qname!r} arms "
+                              f"DISAGREE ({len(hits_c)} vs {len(hits_r)})",
+                              file=sys.stderr)
+                    hits_ref = hits_r
+                ratio = float(np.median(
+                    [c / h for c, h in zip(cold_t, hot_t)]))
+                out[qname] = {
+                    "cold_s": [round(t, 4) for t in cold_t],
+                    "resident_s": [round(t, 4) for t in hot_t],
+                    "cold_over_resident": round(ratio, 3),
+                    "hits": len(hits_ref or ()),
+                    "waterfall": wf,  # last rep's stage split per arm
+                    "transfer": tx,
+                    "tier": tier.stats(),
+                }
+        finally:
+            colcache._shared_device = old_tier
+        return out
+    finally:
+        tmp.cleanup()
+
+
 def _decode_rep(reps: int = 5) -> dict:
     """Per-codec decode throughput (MB/s of DECODED payload): the host
     entropy tier (zstd_shuffle via the native lib, zlib fallback) vs the
@@ -1201,6 +1297,12 @@ def _run(dog, partial: dict):
     partial["standing"] = standing_rep
     print(f"[bench] standing: {standing_rep}", file=sys.stderr)
 
+    # device-resident hot tier: cold fetch+decode vs resident fused
+    # device decode on repeat queries (ISSUE 16 tentpole)
+    hot_tier_rep = _hot_tier_rep()
+    partial["hot_tier"] = hot_tier_rep
+    print(f"[bench] hot_tier: {hot_tier_rep}", file=sys.stderr)
+
     med, spread = _stats(tpu_times)
     blocks_per_s = B_BLOCKS / med
     # paired per-rep ratios: epoch noise hits both arms of a pair, so the
@@ -1247,6 +1349,7 @@ def _run(dog, partial: dict):
         "decode": decode_rep,
         "graph": graph_rep,
         "standing": standing_rep,
+        "hot_tier": hot_tier_rep,
     }))
 
 

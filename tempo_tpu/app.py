@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 
 from tempo_tpu.db import DBConfig, TempoDB
 from tempo_tpu.encoding.common import SearchRequest
+from tempo_tpu.encoding.vtpu.colcache import DeviceTierConfig, configure_device_tier
 from tempo_tpu.modules.compactor_module import CompactorModule
 from tempo_tpu.modules.distributor import Distributor
 from tempo_tpu.modules.frontend import Frontend, FrontendConfig
@@ -112,6 +113,10 @@ class AppConfig:
     # queries fold each ingest cut's delta into per-query accumulators
     # (O(new spans) per evaluation); lives beside the ingesters
     standing: "StandingConfig" = field(default_factory=StandingConfig)
+    # device-resident hot tier (encoding/vtpu/colcache.DeviceTier):
+    # budget_mb > 0 pins the hottest compressed pages in accelerator
+    # memory; scans over them skip fetch+decode+h2d entirely
+    device_tier: "DeviceTierConfig" = field(default_factory=DeviceTierConfig)
 
 
 class RoleUnavailable(RuntimeError):
@@ -128,6 +133,9 @@ class App:
         # pools persist across App rebuilds (modules hold references),
         # only the limits/watermarks move
         self.governor = resource.configure(cfg.resource)
+        # install (or disable) the device-resident hot tier; it binds to
+        # the governor lazily, so order relative to configure() is free
+        configure_device_tier(cfg.device_tier)
         target = cfg.target or "all"
         if target not in ROLES:
             raise ValueError(f"unknown target {target!r} (have {ROLES})")

@@ -396,6 +396,34 @@ def cmd_analyse_device(args) -> int:
     for p in heat.get("pinning", [])[:4]:
         print(f"  pin top {p['pages']} pages ({p['pinnedBytes']:,} B) -> "
               f"saves {p['savedBytes']:,} B ({p['savedRatio']:.1%})")
+    if args.resident:
+        rt = doc.get("residentTier") or r.get("residentTier") or {}
+        print("\ndevice-resident hot tier:")
+        if not rt.get("enabled"):
+            print("  disabled at snapshot time "
+                  "(device_tier.budget_mb=0 / TEMPO_TPU_DEVICE_TIER_MB unset)")
+            return 0
+        st = rt.get("stats", {})
+        print(f"  resident: {st.get('entries', 0)} entries, "
+              f"{st.get('bytes', 0):,} B of {st.get('max_bytes', 0):,} B "
+              f"(effective {st.get('effective_max_bytes', 0):,} B under "
+              "current pressure)")
+        print(f"  hits {st.get('hits', 0)}  misses {st.get('misses', 0)}  "
+              f"admissions {st.get('admissions', 0)}  "
+              f"evictions {st.get('evictions', 0)}  "
+              f"h2d avoided {st.get('avoided_bytes', 0):,} B")
+        print(f"  admission set: {rt.get('admissionSetSize', 0)} pages inside "
+              f"{rt.get('admissionBudgetBytes', 0):,} B (what-if knee "
+              "capped at the configured budget)")
+        rows = [
+            [p.get("block", p.get("key", ""))[:16], p.get("column", "-"),
+             p.get("codec", ""), f"{p.get('deviceBytes', 0):,}",
+             f"{p.get('hostBytes', 0):,}"]
+            for p in rt.get("residentPages", [])[: args.top]
+        ]
+        if rows:
+            _print_table(rows, ["block", "column", "codec", "devBytes",
+                                "hostBytes/hit"])
     return 0
 
 
@@ -702,6 +730,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "ghost-LRU simulation at (default: the snapshot's "
                          "working-set-fraction curve)")
     ad.add_argument("--top", type=int, default=20)
+    ad.add_argument("--resident", action="store_true",
+                    help="also print the device-resident hot tier view "
+                         "captured in the snapshot (resident set, admission "
+                         "budget, avoided-transfer rollup)")
     ad.add_argument("--json", action="store_true")
     ad.set_defaults(fn=cmd_analyse_device)
 

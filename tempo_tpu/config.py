@@ -22,6 +22,7 @@ import yaml
 
 from tempo_tpu.app import AppConfig
 from tempo_tpu.db import DBConfig
+from tempo_tpu.encoding.vtpu.colcache import DeviceTierConfig
 from tempo_tpu.db.compaction import CompactionConfig
 from tempo_tpu.encoding.common import BlockConfig
 from tempo_tpu.modules.forwarder import ForwarderConfig
@@ -195,6 +196,9 @@ def parse_config(text: str, env: dict | None = None) -> Config:
     app.vulture = _from_dict(VultureConfig, doc.pop("vulture", None), "vulture")
     # standing-query engine (registration caps, snapshot cadence, tail)
     app.standing = _from_dict(StandingConfig, doc.pop("standing", None), "standing")
+    # device-resident hot tier (budget_mb=0 disables)
+    app.device_tier = _from_dict(
+        DeviceTierConfig, doc.pop("device_tier", None), "device_tier")
     # burn-rate SLO engine; objectives is a LIST of dataclasses, handled
     # like distributor.forwarders
     slo_doc = doc.pop("slo", {}) or {}
@@ -363,6 +367,35 @@ def check_config(cfg: Config) -> list[str]:
                 )
         except Exception:  # noqa: BLE001 — an uncompilable rule already
             pass  # warned at parse_rules time (dropped loudly)
+    # -- device-resident hot tier -----------------------------------------
+    if app.device_tier.budget_mb > 0:
+        from tempo_tpu.encoding.vtpu.colcache import hbm_headroom_bytes
+
+        budget = app.device_tier.budget_mb << 20
+        headroom = hbm_headroom_bytes()
+        if 0 < headroom < budget:
+            warnings.append(
+                f"device_tier.budget_mb ({app.device_tier.budget_mb}) exceeds "
+                f"detected accelerator memory ({headroom} bytes): admissions "
+                "will OOM the device before the tier's own eviction runs — "
+                "size the tier from the what-if knee, not the whole HBM"
+            )
+        if not app.device_tier.respect_governor:
+            warnings.append(
+                "device_tier.respect_governor=false with a non-zero budget: "
+                "the hot tier will NOT shed under memory pressure, breaking "
+                "the shed order (device tier -> host tier -> ingest refusal) "
+                "the overload plane depends on"
+            )
+        host_cache = int(os.environ.get("TEMPO_TPU_COLCACHE_MB", "256")) << 20
+        if 0 < host_cache < budget:
+            warnings.append(
+                f"host column cache ({host_cache >> 20} MB, "
+                "TEMPO_TPU_COLCACHE_MB) is smaller than device_tier.budget_mb "
+                f"({app.device_tier.budget_mb} MB): an inverted cache "
+                "hierarchy — every device admission rebuilds its payload "
+                "through a host tier too small to hold it"
+            )
     if app.slo.enabled:
         for obj in (app.slo.objectives or slo_mod.default_objectives()):
             if obj.sli not in slo_mod.SLI_SOURCES:

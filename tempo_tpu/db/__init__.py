@@ -380,6 +380,35 @@ class TempoDB:
             out.merge(r, limit=req.limit)
         return out
 
+    def search_multi(self, tenant: str, reqs: list) -> list:
+        """N concurrent tag searches, coalesced: with a device mesh the
+        batched multi-query scan ships (or finds resident) each row
+        group's run payload once and evaluates every request's
+        predicates in fused launches (parallel/search.MeshSearcher.
+        search_blocks_multi). Falls back to N sequential search() calls
+        when the mesh path can't apply. Returns one SearchResponse per
+        request, in order."""
+        reqs = list(reqs)
+        if len(reqs) < 2:
+            return [self.search(tenant, r) for r in reqs]
+        metas = [
+            m for m in self.blocklist.metas(tenant)
+            if any(_overlaps(m, r.start_seconds, r.end_seconds) for r in reqs)
+        ]
+        searcher = self.mesh_searcher()
+        if (searcher is not None and len(metas) > 1
+                and all(m.version == "vtpu1" for m in metas)):
+            blocks = (
+                self.encoding_for(m.version).open_block(m, self.backend, self.cfg.block)
+                for m in metas
+            )
+            return searcher.search_blocks_multi(
+                blocks, reqs,
+                on_block_error=self.block_failure_recorder(tenant),
+                on_block_ok=self.block_success_recorder(tenant),
+            )
+        return [self.search(tenant, r) for r in reqs]
+
     def search_tags(self, tenant: str) -> set:
         """Tag names across this tenant's blocks (parity-plus: the
         reference snapshot's SearchTags covers only ingester data)."""

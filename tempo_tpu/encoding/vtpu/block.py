@@ -233,12 +233,104 @@ class EncodedColumn:
             cache.put(ki, idx)
         return values, idx
 
+    # -- device-resident hot tier --------------------------------------
+    def resident_key(self) -> tuple:
+        return (str(self.blk.meta.block_id), self.name, int(self.pm.offset))
+
+    def _device_tier(self):
+        # one-shot streaming readers (compaction, column_cache=None)
+        # bypass the tier the same way they bypass the heat ledger
+        if self.blk._colcache is None:
+            return None
+        from tempo_tpu.encoding.vtpu.colcache import shared_device_tier
+
+        return shared_device_tier()
+
+    def resident_payload(self):
+        """This page's encoded form as host arrays ready for device
+        placement: (codec, arrays, meta, host_bytes), or None when the
+        shape cannot scan on device (vector columns, >32-bit rle/dct
+        values, multi-subcolumn dbp). host_bytes is what one host-path
+        serve moves — the per-hit avoided-transfer increment."""
+        from tempo_tpu.encoding.vtpu import lightweight as lw
+
+        pm = self.pm
+        if pm.shape and len(pm.shape) > 1:
+            return None
+        if self.codec == "rle":
+            values, lengths = self.runs()
+            # unsigned-only: the device compares in u32, which preserves
+            # equality under wrap but not ordering, and range_mask needs
+            # ordering
+            if (values.ndim != 1 or values.dtype.kind != "u"
+                    or values.dtype.itemsize > 4):
+                return None
+            return ("rle",
+                    {"values": values.astype(np.uint32),
+                     "lengths": lengths.astype(np.int32)},
+                    {"n": self.n},
+                    values.nbytes + lengths.nbytes)
+        if self.codec == "dct":
+            values, idx = self._dct_indices()
+            if (values.ndim != 1 or values.dtype.kind != "u"
+                    or values.dtype.itemsize > 4):
+                return None
+            w = max(values.shape[0] - 1, 0).bit_length()
+            return ("dct",
+                    {"values": values.astype(np.uint32),
+                     "idx": idx.astype(np.int32)},
+                    {"n": self.n},
+                    values.nbytes + (self.n * w + 7) // 8)
+        if self.codec == "dbp":
+            first, _anchors, widths, streams, n = lw.dbp_parts(
+                self._page(), pm.dtype, pm.shape)
+            if len(widths) != 1 or n == 0:
+                return None
+            raw = bytes(streams[0])
+            pad = (-len(raw)) % 4 + 4  # round to words + one guard word
+            words = np.frombuffer(raw + b"\x00" * pad, "<u4")
+            return ("dbp", {"words": words},
+                    {"n": n, "first": int(first[0]), "width": int(widths[0])},
+                    n * np.dtype(pm.dtype).itemsize)
+        return None
+
+    def resident(self):
+        """Resident entry for this page, admitting it (one h2d, counted)
+        when the page-heat ledger puts it inside the what-if knee. The
+        admitting query serves from the fresh entry too — host decode
+        ran once to build the payload, never twice."""
+        tier = self._device_tier()
+        if tier is None:
+            return None
+        key = self.resident_key()
+        res = tier.get(key)
+        if res is not None:
+            return res
+        if not tier.should_admit([key]):
+            return None
+        payload = self.resident_payload()
+        if payload is None:
+            return None
+        codec, arrays, meta, host_bytes = payload
+        if tier.offer(key, codec, arrays, meta, host_bytes=host_bytes):
+            return tier.get(key)
+        return None
+
     # -- predicate evaluation in encoded space -------------------------
     def in_set_mask(self, codes: np.ndarray, invert: bool = False):
         """Row mask for `column in codes` (1-D columns), or None when
         this codec cannot answer without full decode (dbp)."""
         from tempo_tpu.ops import scan
 
+        res = self.resident()
+        if res is not None:
+            m = scan.resident_in_set_mask(res, codes, invert=invert)
+            if m is not None:
+                # fetch+decode+h2d all skipped: the fused device decode
+                # ran over the parked compressed page
+                self._device_tier().record_avoided(
+                    res.host_bytes, kernel=f"resident_{res.codec}_scan")
+                return m
         if self.codec == "rle":
             values, lengths = self.runs()
             return scan.expand_run_mask(
@@ -250,9 +342,18 @@ class EncodedColumn:
         return None
 
     def range_mask(self, lo, hi):
-        """Row mask for lo <= column <= hi, or None (dbp/entropy)."""
+        """Row mask for lo <= column <= hi, or None (dbp/entropy —
+        though a RESIDENT dbp page answers: its device delta-decode is
+        fused into the limb compare)."""
         from tempo_tpu.ops import scan
 
+        res = self.resident()
+        if res is not None:
+            m = scan.resident_range_mask(res, lo, hi)
+            if m is not None:
+                self._device_tier().record_avoided(
+                    res.host_bytes, kernel=f"resident_{res.codec}_scan")
+                return m
         if self.codec == "rle":
             values, lengths = self.runs()
             return scan.expand_run_mask(

@@ -1,4 +1,5 @@
-"""Process-wide decoded-column cache for the default read path.
+"""Process-wide column caches: host tier (decoded arrays) + device tier
+(COMPRESSED pages resident in accelerator memory).
 
 Reference analog: the reference caches parquet footers/column pages
 across queries (vparquet/readers.go over tempodb/backend/cache). Here
@@ -20,12 +21,27 @@ Sizing: TEMPO_TPU_COLCACHE_MB (default 256; 0 disables). One shared
 instance serves every block of the process — queriers, the API server
 and the mesh searcher all hit the same working set, like the
 reference's shared backend cache.
+
+The DEVICE tier (`DeviceTier`, sized by TEMPO_TPU_DEVICE_TIER_MB or the
+`device_tier` config section; 0 = off) closes the transfer-ledger loop:
+the hottest (block, column) pages — in their ENCODED run/dict/packed
+form, 10-50x smaller than decoded rows — are admitted as device arrays
+at the knee of the ghost-LRU what-if curve (util/pageheat.admission_*),
+so repeat queries skip fetch+decode+h2d entirely and run the bit-exact
+device decode fused into the scan (ops/scan resident kernels,
+parallel/search's resident mesh path). Eviction rides the governor's
+pressure levels, MORE aggressively than the host tier: at PRESSURE the
+device tier drops to a quarter (host halves), at CRITICAL it sheds
+completely (host keeps an eighth) — device memory yields first, host
+cache second, and only then does ingest refuse.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import threading
+import time
 from collections import OrderedDict
 
 from tempo_tpu.util import usage
@@ -97,6 +113,7 @@ class ColumnCache:
     def stats(self) -> dict:
         with self._lock:
             return {
+                "tier": "host",
                 "hits": self.hits,
                 "misses": self.misses,
                 "evictions": self.evictions,
@@ -131,25 +148,382 @@ def shared_cache() -> ColumnCache | None:
     return _shared
 
 
-def _register_metrics(cache: ColumnCache) -> None:
+def _register_metrics(cache) -> None:
     """Publish cache stats on /metrics (reference: the backend cache's
     promauto gauges): a collector refreshes the gauges from stats() at
     every exposition, so read-path cache behavior is observable
-    process-wide, not just per bench run."""
+    process-wide, not just per bench run. The `tier` label keeps the
+    host and device tiers separate series of ONE family — dashboards
+    sum them or split them, but the counters never conflate."""
     from tempo_tpu.util import metrics
 
     gauges = {
         name: metrics.gauge(
             f"tempo_tpu_colcache_{name}",
-            f"Shared decoded-column cache {name} (colcache.stats)",
+            f"Column cache {name} by tier (host=decoded arrays, "
+            "device=resident compressed pages; colcache.stats)",
         )
         for name in ("hits", "misses", "evictions", "bytes", "entries")
     }
 
     def collect():
-        for name, value in cache.stats().items():
+        stats = cache.stats()
+        tier = stats.get("tier", "host")
+        for name, value in stats.items():
             g = gauges.get(name)
             if g is not None:
-                g.set(value)
+                g.set(value, tier=tier)
 
     metrics.register_collector(collect)
+
+
+# ---------------------------------------------------------------------------
+# device-resident hot tier
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class DeviceTierConfig:
+    """Config section `device_tier` (env analog TEMPO_TPU_DEVICE_TIER_MB
+    for the budget). budget_mb=0 disables the tier entirely — the
+    default, so single-shot workloads never pay device memory for pages
+    they will not re-scan."""
+
+    budget_mb: int = 0
+    # a page must have re-shipped at least this often before it can be
+    # admitted (the first ship is unavoidable; one re-ship may be noise)
+    admit_min_ships: int = 2
+    # how often the admission set is recomputed from the page-heat
+    # ledger's what-if knee
+    refresh_s: float = 30.0
+    # False detaches eviction from the governor's pressure levels —
+    # check_config warns, because an unshed device tier competes with
+    # live ingest for memory the governor cannot see coming back
+    respect_governor: bool = True
+    # fused multi-query dispatch width (parallel/search batched seam)
+    max_query_batch: int = 8
+
+
+class _Resident:
+    """One resident entry: device arrays of an ENCODED page form plus
+    the host-side metadata needed to scan it without re-reading."""
+
+    __slots__ = ("codec", "arrays", "meta", "nbytes", "host_bytes")
+
+    def __init__(self, codec: str, arrays: dict, meta: dict,
+                 host_bytes: int):
+        self.codec = codec
+        self.arrays = arrays
+        self.meta = meta or {}
+        self.nbytes = sum(int(getattr(a, "nbytes", 0)) for a in arrays.values())
+        # what one host-path serve of this page would have shipped h2d —
+        # the per-hit "transfer bytes avoided" increment
+        self.host_bytes = int(host_bytes)
+
+
+class DeviceTier:
+    """Bytes-bounded LRU of COMPRESSED pages held as device arrays.
+
+    Admission is the closed loop over the page-heat ledger: a key is
+    admitted only while it is in the current admission set — the
+    hottest pages by re-ship bytes, packed into the KNEE budget of the
+    ghost-LRU what-if curve (capped by the configured budget). Eviction
+    is LRU within the pressure-scaled budget; the factors are harsher
+    than the host cache's on purpose — device memory is the scarcest
+    pool and must yield before the host tier, long before ingest
+    refuses (shed order: device tier -> host tier -> ingest)."""
+
+    _PRESSURE_FACTORS = {0: 1.0, 1: 0.25, 2: 0.0}
+
+    def __init__(self, budget_bytes: int, governor=None,
+                 admit_min_ships: int = 2, refresh_s: float = 30.0,
+                 respect_governor: bool = True, max_query_batch: int = 8):
+        self.budget_bytes = int(budget_bytes)
+        self._governor = governor  # None = process governor, bound lazily
+        self.admit_min_ships = int(admit_min_ships)
+        self.refresh_s = float(refresh_s)
+        self.respect_governor = respect_governor
+        self.max_query_batch = max(1, int(max_query_batch))
+        self._lru: OrderedDict = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.admissions = 0
+        self.avoided_bytes = 0
+        # admission set: frozenset of (block_id, column, offset) keys,
+        # recomputed from the ledger at most every refresh_s
+        self._admit_keys: frozenset = frozenset()
+        self._admit_budget = 0
+        self._admit_at = 0.0
+
+    # -- pressure ------------------------------------------------------
+    def _level(self) -> int:
+        gov = self._governor
+        if gov is None:
+            from tempo_tpu.util import resource
+
+            gov = self._governor = resource.governor()
+        return gov.level()
+
+    def effective_budget_bytes(self) -> int:
+        if not self.respect_governor:
+            return self.budget_bytes
+        return int(self.budget_bytes
+                   * self._PRESSURE_FACTORS.get(self._level(), 1.0))
+
+    def shed(self) -> int:
+        """Evict LRU-first down to the pressure-scaled budget. Called on
+        every get/offer (cheap when under budget) and by the governor's
+        metrics collector, so a pressure spike empties the tier even if
+        no query arrives to trigger it. Dropping the reference IS the
+        device free — jax reclaims the buffer."""
+        limit = self.effective_budget_bytes()
+        n = 0
+        with self._lock:
+            while self._bytes > limit and self._lru:
+                _, res = self._lru.popitem(last=False)
+                self._bytes -= res.nbytes
+                self.evictions += 1
+                n += 1
+        return n
+
+    # -- admission set -------------------------------------------------
+    def refresh_admission(self, force: bool = False) -> None:
+        """Recompute the admission set from the page-heat ledger: knee
+        of the what-if curve, capped at the configured budget, packed
+        by re-ship bytes (pageheat.admission_candidates)."""
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._admit_at < self.refresh_s:
+                return
+            self._admit_at = now
+        from tempo_tpu.util import pageheat
+
+        rep = pageheat.admission_report(budget_bytes=self.budget_bytes,
+                                        min_ships=self.admit_min_ships)
+        keys = frozenset((c["block"], c["column"], c["offset"])
+                         for c in rep["candidates"])
+        with self._lock:
+            self._admit_keys = keys
+            self._admit_budget = rep["effectiveBudgetBytes"]
+
+    def should_admit(self, page_keys) -> bool:
+        """True when EVERY (block_id, column, offset) in page_keys is in
+        the current admission set — composite entries (the mesh path's
+        stacked chunks) admit only when all their pages are hot."""
+        self.refresh_admission()
+        with self._lock:
+            admit = self._admit_keys
+        if not admit:
+            return False
+        return all((str(b), c, int(o)) in admit for b, c, o in page_keys)
+
+    # -- get/put -------------------------------------------------------
+    def get(self, key):
+        self.shed()
+        with self._lock:
+            res = self._lru.get(key)
+            if res is not None:
+                self._lru.move_to_end(key)
+                self.hits += 1
+            else:
+                self.misses += 1
+        return res
+
+    def offer(self, key, codec: str, arrays: dict, meta: dict | None = None,
+              host_bytes: int = 0, page_keys=None) -> bool:
+        """Admission path: host numpy arrays of one encoded page form go
+        to device HERE (the one h2d this page pays from now on) iff the
+        page is in the admission set and fits the pressure-scaled
+        budget. Returns True when the entry is resident after the call.
+
+        page_keys: the (block_id, column, offset) identities backing
+        this entry (defaults to [key] when key has that shape); the
+        admission set is consulted per page."""
+        with self._lock:
+            if key in self._lru:
+                self._lru.move_to_end(key)
+                return True
+        if page_keys is None:
+            page_keys = [key]
+        if not self.should_admit(page_keys):
+            return False
+        limit = self.effective_budget_bytes()
+        nbytes = sum(int(a.nbytes) for a in arrays.values())
+        if nbytes > limit or nbytes <= 0:
+            return False
+        import jax.numpy as jnp
+
+        from tempo_tpu.util import devicetiming
+
+        dev = {name: jnp.asarray(a) for name, a in arrays.items()}
+        # the admission copy is a real h2d ship — measured where it
+        # happens, so the tier can never LOWER apparent transfer by
+        # hiding its own warm-up traffic
+        devicetiming.count_transfer("device_tier_admit", h2d=nbytes)
+        res = _Resident(codec, dev, meta or {}, host_bytes or nbytes)
+        with self._lock:
+            prev = self._lru.get(key)
+            if prev is not None:
+                self._bytes -= prev.nbytes
+            self._lru[key] = res
+            self._bytes += res.nbytes
+            self.admissions += 1
+            while self._bytes > limit and self._lru:
+                _, evicted = self._lru.popitem(last=False)
+                self._bytes -= evicted.nbytes
+                self.evictions += 1
+        return True
+
+    def record_avoided(self, nbytes: int, kernel: str = "resident_scan") -> None:
+        """One resident-tier serve elided `nbytes` of h2d: feed the
+        transfer plane's avoided counter + the tier's own rollup."""
+        from tempo_tpu.util import devicetiming
+
+        with self._lock:
+            self.avoided_bytes += int(nbytes)
+        devicetiming.count_avoided(kernel, nbytes)
+
+    # -- views ---------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "tier": "device",
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "admissions": self.admissions,
+                "bytes": self._bytes,
+                "entries": len(self._lru),
+                "avoided_bytes": self.avoided_bytes,
+                "max_bytes": self.budget_bytes,
+                "effective_max_bytes": self.effective_budget_bytes(),
+            }
+
+    def resident_pages(self, top: int = 50) -> list:
+        """MRU-first listing for /status/device and the CLI."""
+        with self._lock:
+            items = list(reversed(self._lru.items()))[:top]
+        out = []
+        for key, res in items:
+            row = {"codec": res.codec, "deviceBytes": res.nbytes,
+                   "hostBytes": res.host_bytes}
+            if (isinstance(key, tuple) and len(key) == 3
+                    and isinstance(key[1], str)):
+                row.update(block=str(key[0]), column=key[1],
+                           offset=int(key[2]))
+            else:
+                row["key"] = repr(key)
+            out.append(row)
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._lru.clear()
+            self._bytes = 0
+
+
+_shared_device: DeviceTier | None = None
+_device_lock = threading.Lock()
+_device_metrics_armed = False
+
+
+def _arm_device_metrics() -> None:
+    """ONE collector, registered once, reading whichever tier is
+    currently installed — reconfiguration must not stack collectors or
+    leave a replaced tier publishing stale series. The collector also
+    sheds: a pressure spike empties the tier at the next exposition
+    even if no query arrives to trigger eviction (the governor hook)."""
+    global _device_metrics_armed
+    if _device_metrics_armed:
+        return
+    _device_metrics_armed = True
+
+    class _Current:
+        @staticmethod
+        def stats():
+            tier = _shared_device
+            if tier is None:
+                return {"tier": "device"}
+            tier.shed()
+            return tier.stats()
+
+    _register_metrics(_Current)
+
+
+def configure_device_tier(cfg: "DeviceTierConfig | None") -> DeviceTier | None:
+    """Install (or disable) the process-wide device tier from config —
+    App startup calls this; tests hand modules private instances
+    instead. Replacing an enabled tier drops the old one's residents."""
+    global _shared_device
+    with _device_lock:
+        if cfg is None or cfg.budget_mb <= 0:
+            _shared_device = None
+            return None
+        tier = DeviceTier(
+            cfg.budget_mb << 20,
+            admit_min_ships=cfg.admit_min_ships,
+            refresh_s=cfg.refresh_s,
+            respect_governor=cfg.respect_governor,
+            max_query_batch=cfg.max_query_batch,
+        )
+        _arm_device_metrics()
+        _shared_device = tier
+        return tier
+
+
+def shared_device_tier() -> DeviceTier | None:
+    """The process-wide device tier, or None when disabled (the default:
+    no config and TEMPO_TPU_DEVICE_TIER_MB unset/0)."""
+    global _shared_device
+    if _shared_device is None:
+        with _device_lock:
+            if _shared_device is None:
+                mb = int(os.environ.get("TEMPO_TPU_DEVICE_TIER_MB", "0"))
+                if mb <= 0:
+                    return None
+                tier = DeviceTier(mb << 20)
+                _arm_device_metrics()
+                _shared_device = tier
+    return _shared_device
+
+
+def hbm_headroom_bytes() -> int:
+    """Detected accelerator memory limit for the default device, or 0
+    when unknown (CPU backends report no limit). TEMPO_TPU_HBM_BYTES
+    overrides for fleets whose runtime under-reports. check_config
+    compares the configured tier budget against this."""
+    env = os.environ.get("TEMPO_TPU_HBM_BYTES", "")
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            return 0
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats() or {}
+        return int(stats.get("bytes_limit", 0) or 0)
+    except Exception:
+        return 0
+
+
+def device_tier_report() -> dict:
+    """The /status/device `residentTier` section: enabled/budget/stats +
+    the resident set, plus the admission decision that produced it."""
+    tier = shared_device_tier()
+    if tier is None:
+        return {"enabled": False}
+    tier.refresh_admission()
+    with tier._lock:
+        admit_budget = tier._admit_budget
+        admit_size = len(tier._admit_keys)
+    return {
+        "enabled": True,
+        "stats": tier.stats(),
+        "admissionBudgetBytes": admit_budget,
+        "admissionSetSize": admit_size,
+        "residentPages": tier.resident_pages(),
+    }

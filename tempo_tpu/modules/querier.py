@@ -143,6 +143,54 @@ class Querier:
             resp.merge(self.search_block_job(tenant, block_id, req), limit=req.limit)
         return resp
 
+    def search_multi(self, tenant: str, reqs: list) -> list:
+        """N concurrent searches (a live-tail fan: dashboards, standing
+        queries and humans asking overlapping questions about the same
+        recent data) answered together: the recent/live segments scan
+        per request on host, while the block portion coalesces into the
+        batched multi-query device scan — one fused launch per
+        query-batch instead of one per query, served from the
+        device-resident hot tier when the pages are pinned."""
+        reqs = list(reqs)
+        if not reqs:
+            return []
+        block = self.db.search_multi(tenant, reqs)
+        out = []
+        for req, blocks_resp in zip(reqs, block):
+            r = self.search_recent(tenant, req)
+            r.merge(blocks_resp, limit=req.limit)
+            out.append(r)
+        return out
+
+    def search_block_batch_multi(self, tenant: str, block_ids: list,
+                                 reqs: list) -> list:
+        """The job-level multi-query seam: one frontend job carrying N
+        requests against the same block batch. Same routing rules as
+        search_block_batch; ineligible setups fall back to sequential
+        per-request jobs (bit-identical results, N dispatches)."""
+        reqs = list(reqs)
+        if not reqs:
+            return []
+        searcher = self.db.mesh_searcher() if not self.external_endpoints else None
+        if searcher is not None and len(reqs) > 1 and len(block_ids) > 1:
+            metas = []
+            for bid in block_ids:
+                try:
+                    metas.append(self.db.backend.block_meta(tenant, bid))
+                except NotFound:
+                    log.warning("search job: block %s deleted mid-query", bid)
+            if metas and all(m.version == "vtpu1" for m in metas):
+                blocks = (
+                    self.db.encoding_for(m.version).open_block(m, self.db.backend, self.db.cfg.block)
+                    for m in metas
+                )
+                return searcher.search_blocks_multi(
+                    blocks, reqs,
+                    on_block_error=self.db.block_failure_recorder(tenant),
+                    on_block_ok=self.db.block_success_recorder(tenant),
+                )
+        return [self.search_block_batch(tenant, block_ids, r) for r in reqs]
+
     def _search_external(self, tenant, block_id, req, start_row_group, row_groups) -> SearchResponse:
         """Delegate one block-search job to a serverless endpoint."""
         import urllib.parse

@@ -487,6 +487,60 @@ def rle_cols_hit(values: jnp.ndarray, lengths: jnp.ndarray,
     return hit
 
 
+def rle_cols_hit_live(values: jnp.ndarray, lengths: jnp.ndarray,
+                      codes: jnp.ndarray, live: jnp.ndarray,
+                      n: int, hit: jnp.ndarray) -> jnp.ndarray:
+    """rle_cols_hit with a per-column participation flag: `live` (C,)
+    bool — a column this query did not constrain contributes accept-all
+    instead of its verdict. The multi-query body: one run payload, Q
+    different (codes, live) pairs vmapped over it, so N concurrent
+    queries with overlapping page sets pay ONE decode+scan launch."""
+    C, K = codes.shape
+    for c in range(C):
+        run_hit = jnp.zeros(values.shape[1], bool)
+        for k in range(K):
+            code = codes[c, k]
+            run_hit = run_hit | ((values[c] == code)
+                                 & (code != jnp.uint32(0xFFFFFFFF)))
+        row_hit = jnp.repeat(run_hit, lengths[c], total_repeat_length=n)
+        hit = hit & (row_hit | ~live[c])
+    return hit
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _batched_rle_in_set_jit(values: jnp.ndarray, lengths: jnp.ndarray,
+                            codes: jnp.ndarray, live: jnp.ndarray,
+                            valid: jnp.ndarray, n: int) -> jnp.ndarray:
+    """values/lengths (C, R) — ONE unit's run payload; codes (Q, C, K),
+    live (Q, C), valid (n,) -> (Q, n) bool. The single-device batched
+    multi-query scan: the payload is traced once and every query's
+    verdict reuses it in-register."""
+
+    def one(cd, lv):
+        return rle_cols_hit_live(values, lengths, cd, lv, n, valid)
+
+    return jax.vmap(one)(codes, live)
+
+
+def batched_rle_in_set(values, lengths, codes: np.ndarray, live: np.ndarray,
+                       valid: np.ndarray, n: int) -> np.ndarray:
+    """Host wrapper for the batched multi-query scan. values/lengths may
+    be numpy (shipped, counted h2d) OR device arrays from the resident
+    hot tier (counted resident, zero movement) — the batching and the
+    hot tier compose: N queries x 1 scan x 0 bytes shipped."""
+    from tempo_tpu.util.devicetiming import timed_dispatch
+
+    if isinstance(values, np.ndarray):
+        values = values.astype(np.uint32)
+    if isinstance(lengths, np.ndarray):
+        lengths = lengths.astype(np.int32)
+    return np.asarray(timed_dispatch(
+        "batched_rle_scan", _batched_rle_in_set_jit,
+        values, lengths, codes.astype(np.uint32),
+        live.astype(bool), valid.astype(bool), n,
+    ))
+
+
 @functools.partial(jax.jit, static_argnames=("n",))
 def _fused_rle_in_set_jit(values: jnp.ndarray, lengths: jnp.ndarray,
                           codes: jnp.ndarray, n: int) -> jnp.ndarray:

@@ -18,6 +18,8 @@ index that block encoding stores per row group.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 import jax
@@ -147,6 +149,154 @@ def runs_firsts_seg(run_lengths: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         np.cumsum(lens[:-1], out=firsts[1:])
     seg = np.repeat(np.arange(len(lens), dtype=np.int64), lens)
     return firsts, seg
+
+
+# ---------------------------------------------------------------------------
+# resident-tier fused scans (device-resident COMPRESSED pages)
+# ---------------------------------------------------------------------------
+#
+# The hot tier (encoding/vtpu/colcache.DeviceTier) parks encoded page
+# forms — rle runs, dct dictionary+indices, dbp packed words — as device
+# arrays. A scan that hits the tier never touches fetch/decode/h2d: the
+# kernels below fuse the (bit-exact) device decode into the predicate
+# compare, and the only bytes that ship per query are the predicate's
+# code set / bounds (a few hundred bytes). Run semantics mirror the
+# run-space host helpers above EXACTLY — code-set padding repeats a real
+# code instead of a sentinel, so device membership is np.isin
+# bit-for-bit even against pathological column values.
+
+
+@functools.partial(jax.jit, static_argnames=("n", "invert"))
+def _rle_in_set_resident_jit(values, lengths, codes, n: int, invert: bool):
+    """values/lengths (R,) resident; codes (K,) shipped -> (n,) bool."""
+    run_hit = jnp.any(values[:, None] == codes[None, :].astype(values.dtype),
+                      axis=1)
+    if invert:
+        run_hit = ~run_hit
+    return jnp.repeat(run_hit, lengths, total_repeat_length=n)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _rle_between_resident_jit(values, lengths, lo, hi, n: int):
+    run_hit = (values >= lo.astype(values.dtype)) \
+        & (values <= hi.astype(values.dtype))
+    return jnp.repeat(run_hit, lengths, total_repeat_length=n)
+
+
+@functools.partial(jax.jit, static_argnames=("invert",))
+def _dct_in_set_resident_jit(dvals, idx, codes, invert: bool):
+    """dvals (V,) page dictionary + idx (n,) resident -> (n,) bool: the
+    verdict is computed once per dictionary ENTRY and gathered by the
+    resident index — the dct analog of the per-run verdict."""
+    hit = jnp.any(dvals[:, None] == codes[None, :].astype(dvals.dtype),
+                  axis=1)
+    if invert:
+        hit = ~hit
+    return hit[idx]
+
+
+@jax.jit
+def _dct_between_resident_jit(dvals, idx, lo, hi):
+    hit = (dvals >= lo.astype(dvals.dtype)) & (dvals <= hi.astype(dvals.dtype))
+    return hit[idx]
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _dbp_between_resident_jit(words, first_hi, first_lo, width, bounds,
+                              n: int):
+    """Resident packed-delta words -> range verdict, decode fused in:
+    the same _dbp_decode_jit the shipped path uses (bit-identical limbs)
+    followed by the two-limb u64 compare. bounds (4,) uint32 =
+    [lo_hi, lo_lo, hi_hi, hi_lo]."""
+    from tempo_tpu.ops.pallas_kernels import _dbp_decode_jit
+
+    h, l = _dbp_decode_jit(words, first_hi, first_lo, width, n)
+    ge = (h > bounds[0]) | ((h == bounds[0]) & (l >= bounds[1]))
+    le = (h < bounds[2]) | ((h == bounds[2]) & (l <= bounds[3]))
+    return ge & le
+
+
+def _pad_codes_u32(codes: np.ndarray) -> np.ndarray:
+    """Pow2-pad a code set by REPEATING its first code (bounds the jit
+    cache without changing membership — unlike a sentinel pad, which
+    would alter verdicts for columns that contain the sentinel)."""
+    codes = np.asarray(codes).astype(np.uint32, copy=False).reshape(-1)
+    if codes.size == 0:
+        codes = np.array([NO_MATCH_CODE], np.uint32)
+    k = 1
+    while k < codes.size:
+        k <<= 1
+    if k == codes.size:
+        return codes
+    return np.concatenate([codes, np.full(k - codes.size, codes[0], np.uint32)])
+
+
+def resident_in_set_mask(res, codes: np.ndarray,
+                         invert: bool = False) -> np.ndarray | None:
+    """Row mask for `column in codes` served from one resident entry
+    (colcache._Resident duck type: .codec/.arrays/.meta), or None when
+    the resident form cannot answer (dbp). Dispatches under the timing
+    seam: the resident arrays count as `resident`, never h2d — only the
+    code set ships."""
+    from tempo_tpu.util.devicetiming import timed_dispatch
+
+    codes = _pad_codes_u32(codes)
+    n = int(res.meta["n"])
+    if res.codec == "rle":
+        if n == 0:
+            return np.zeros(0, bool)
+        mask = timed_dispatch(
+            "resident_rle_scan", _rle_in_set_resident_jit,
+            res.arrays["values"], res.arrays["lengths"], codes, n,
+            bool(invert))
+        return np.asarray(mask)
+    if res.codec == "dct":
+        if n == 0:
+            return np.zeros(0, bool)
+        mask = timed_dispatch(
+            "resident_dct_scan", _dct_in_set_resident_jit,
+            res.arrays["values"], res.arrays["idx"], codes, bool(invert))
+        return np.asarray(mask)
+    return None
+
+
+def resident_range_mask(res, lo, hi) -> np.ndarray | None:
+    """Row mask for lo <= column <= hi from one resident entry; dbp
+    pages answer by fusing the device delta-decode into the compare."""
+    from tempo_tpu.util.devicetiming import timed_dispatch
+
+    n = int(res.meta["n"])
+    if res.codec == "rle":
+        if n == 0:
+            return np.zeros(0, bool)
+        mask = timed_dispatch(
+            "resident_rle_scan", _rle_between_resident_jit,
+            res.arrays["values"], res.arrays["lengths"],
+            np.uint32(lo), np.uint32(hi), n)
+        return np.asarray(mask)
+    if res.codec == "dct":
+        if n == 0:
+            return np.zeros(0, bool)
+        mask = timed_dispatch(
+            "resident_dct_scan", _dct_between_resident_jit,
+            res.arrays["values"], res.arrays["idx"],
+            np.uint32(lo), np.uint32(hi))
+        return np.asarray(mask)
+    if res.codec == "dbp":
+        if n == 0:
+            return np.zeros(0, bool)
+        lo64, hi64 = int(lo), int(hi)
+        bounds = np.array(
+            [lo64 >> 32, lo64 & 0xFFFFFFFF, hi64 >> 32, hi64 & 0xFFFFFFFF],
+            np.uint32)
+        first = int(res.meta["first"])
+        mask = timed_dispatch(
+            "resident_dbp_scan", _dbp_between_resident_jit,
+            res.arrays["words"],
+            np.uint32(first >> 32), np.uint32(first & 0xFFFFFFFF),
+            np.int32(res.meta["width"]), bounds, n)
+        return np.asarray(mask)
+    return None
 
 
 # ---------------------------------------------------------------------------

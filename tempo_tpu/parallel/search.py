@@ -29,6 +29,7 @@ from jax.sharding import PartitionSpec as P
 
 from tempo_tpu.ops import bloom
 from tempo_tpu.parallel.mesh import RANGE_AXIS, WINDOW_AXIS, shard_map_compat
+from tempo_tpu.util import metrics
 from tempo_tpu.util.devicetiming import timed_dispatch
 
 # Serializes mesh-program dispatch across threads. Collective programs
@@ -39,6 +40,13 @@ from tempo_tpu.util.devicetiming import timed_dispatch
 # CPU mesh). Device execution is serial per device anyway, so holding
 # one lock across dispatch + result materialization costs nothing.
 _dispatch_lock = threading.Lock()
+
+# fused-batch width observability: mean width over a window =
+# rate(lanes) / rate(tempo_tpu_device_dispatches_total{kernel="batched_rle_scan"})
+batched_lanes_total = metrics.counter(
+    "tempo_tpu_batched_query_lanes_total",
+    "Active query lanes served by fused multi-query scan dispatches",
+)
 
 
 @lru_cache(maxsize=32)
@@ -154,6 +162,53 @@ def make_sharded_rle_scan(mesh, n_cols: int, max_codes: int, n_pad: int):
             step,
             mesh=mesh,
             in_specs=(spec, spec, spec, spec),
+            out_specs=(spec, P(WINDOW_AXIS)),
+        )
+    )
+
+
+@lru_cache(maxsize=32)
+def make_sharded_batched_rle_scan(mesh, n_cols: int, max_codes: int,
+                                  q: int, n_pad: int):
+    """The multi-query variant of make_sharded_rle_scan: ONE run payload
+    per shard, Q independent predicate sets scanned over it in a single
+    launch. N concurrent queries with overlapping page sets coalesce to
+    ceil(N / Q) dispatches instead of N — and when the payload sits in
+    the device-resident hot tier, zero bytes ship.
+
+    Inputs (stacked over the (W, R) mesh axes):
+      values  (W, R, C, RP) uint32 — shared run payload, NO_MATCH-padded
+      lengths (W, R, C, RP) int32
+      codes   (W, R, Q, C, K) uint32 — per-query accepted code sets
+      live    (W, R, Q, C) bool — which columns each query constrained
+              (a dead column is accept-all; a fully dead query row is a
+              pad lane whose mask the caller must ignore)
+      valid   (W, R, N) bool
+    Returns (masks (W, R, Q, N) bool, hits (W, Q) int32).
+    """
+
+    from tempo_tpu.ops.pallas_kernels import rle_cols_hit_live
+
+    def local(values, lengths, codes, live, valid):
+        def one(cd, lv):
+            return rle_cols_hit_live(values, lengths, cd, lv, n_pad, valid)
+
+        hit = jax.vmap(one)(codes, live)
+        count = jnp.sum(hit.astype(jnp.int32), axis=1)
+        total = jax.lax.psum(count, RANGE_AXIS)
+        return hit, total
+
+    def step(values, lengths, codes, live, valid):
+        hit, total = local(values[0, 0], lengths[0, 0], codes[0, 0],
+                           live[0, 0], valid[0, 0])
+        return hit[None, None], total[None, None]
+
+    spec = P(WINDOW_AXIS, RANGE_AXIS)
+    return jax.jit(
+        shard_map_compat(
+            step,
+            mesh=mesh,
+            in_specs=(spec, spec, spec, spec, spec),
             out_specs=(spec, P(WINDOW_AXIS)),
         )
     )
@@ -377,55 +432,99 @@ class MeshSearcher:
                 unit_encs.append(row)
 
             if unit_encs is not None:
-                max_runs = 8
-                unit_runs = []
-                for s, (blk, i, rg, preds) in enumerate(chunk):
-                    try:
-                        runs = [with_retries(e.runs) for e in unit_encs[s]]
-                    except Exception as e:  # e.g. block deleted mid-query
-                        errors.append((blk, e))
-                        log.warning("mesh search: run load failed: %s", e)
-                        unit_runs.append(None)
-                        continue
-                    unit_runs.append(runs)
-                    for v, l in runs:
-                        max_runs = max(max_runs, len(l))
-                run_pad = 1 << (max_runs - 1).bit_length()
-                values = np.full((cap, n_cols, run_pad), NO_MATCH, np.uint32)
-                lengths = np.zeros((cap, n_cols, run_pad), np.int32)
-                for s, (blk, i, rg, preds) in enumerate(chunk):
-                    if unit_runs[s] is None:
-                        continue
-                    for c, ((col_name, accept), (v, l)) in enumerate(
-                            zip(preds["span_eq"], unit_runs[s])):
-                        values[s, c, : len(v)] = v.astype(np.uint32)
-                        lengths[s, c, : len(l)] = l
-                        k = min(len(accept), self.max_codes)
-                        codes[s, c, :k] = accept[:k]
-                    for c in range(len(preds["span_eq"]), n_cols):
-                        # fewer predicates than the widest: accept-all
-                        # (one all-covering run of value 0, code 0)
-                        values[s, c, 0] = 0
-                        lengths[s, c, 0] = rg.n_spans
-                        codes[s, c, 0] = 0
-                    valid[s, : rg.n_spans] = True
-                    live.append(s)
+                from tempo_tpu.encoding.vtpu.colcache import shared_device_tier
+
+                tier = shared_device_tier()
+                pkeys = tuple(tuple(e.resident_key() for e in row)
+                              for row in unit_encs)
+                skey = ("mesh_stack", pkeys, n_cols, pad)
+                res = tier.get(skey) if tier is not None else None
+                if res is not None:
+                    # resident hot path: the stacked run payload is
+                    # already parked on device — skip run loading and
+                    # host stacking entirely; only the (tiny) per-query
+                    # codes + valid ship
+                    run_pad = int(res.meta["run_pad"])
+                    dev_values = res.arrays["values"]
+                    dev_lengths = res.arrays["lengths"]
+                    tier.record_avoided(res.host_bytes, kernel="mesh_rle_scan")
+                    for s, (blk, i, rg, preds) in enumerate(chunk):
+                        for c, (col_name, accept) in enumerate(preds["span_eq"]):
+                            k = min(len(accept), self.max_codes)
+                            codes[s, c, :k] = accept[:k]
+                        for c in range(len(preds["span_eq"]), n_cols):
+                            codes[s, c, 0] = 0
+                        valid[s, : rg.n_spans] = True
+                        live.append(s)
+                else:
+                    max_runs = 8
+                    unit_runs = []
+                    for s, (blk, i, rg, preds) in enumerate(chunk):
+                        try:
+                            runs = [with_retries(e.runs) for e in unit_encs[s]]
+                        except Exception as e:  # e.g. block deleted mid-query
+                            errors.append((blk, e))
+                            log.warning("mesh search: run load failed: %s", e)
+                            unit_runs.append(None)
+                            continue
+                        unit_runs.append(runs)
+                        for v, l in runs:
+                            max_runs = max(max_runs, len(l))
+                    run_pad = 1 << (max_runs - 1).bit_length()
+                    values = np.full((cap, n_cols, run_pad), NO_MATCH, np.uint32)
+                    lengths = np.zeros((cap, n_cols, run_pad), np.int32)
+                    for s, (blk, i, rg, preds) in enumerate(chunk):
+                        if unit_runs[s] is None:
+                            continue
+                        for c, ((col_name, accept), (v, l)) in enumerate(
+                                zip(preds["span_eq"], unit_runs[s])):
+                            values[s, c, : len(v)] = v.astype(np.uint32)
+                            lengths[s, c, : len(l)] = l
+                            k = min(len(accept), self.max_codes)
+                            codes[s, c, :k] = accept[:k]
+                        for c in range(len(preds["span_eq"]), n_cols):
+                            # fewer predicates than the widest: accept-all
+                            # (one all-covering run of value 0, code 0)
+                            values[s, c, 0] = 0
+                            lengths[s, c, 0] = rg.n_spans
+                            codes[s, c, 0] = 0
+                        valid[s, : rg.n_spans] = True
+                        live.append(s)
+                    dev_values = values.reshape(self.w, self.r, n_cols, run_pad)
+                    dev_lengths = lengths.reshape(self.w, self.r, n_cols, run_pad)
+                    if tier is not None and all(r is not None for r in unit_runs):
+                        # offer the WHOLE stack; admitted only when every
+                        # page in it sits inside the what-if knee. The
+                        # admitting dispatch serves from the fresh entry
+                        # too (one ship, counted as device_tier_admit)
+                        tier.offer(skey, "rle_stack",
+                                   {"values": dev_values,
+                                    "lengths": dev_lengths},
+                                   meta={"run_pad": run_pad},
+                                   host_bytes=values.nbytes + lengths.nbytes,
+                                   page_keys=[k for row in pkeys for k in row])
+                        got = tier.get(skey)
+                        if got is not None:
+                            dev_values = got.arrays["values"]
+                            dev_lengths = got.arrays["lengths"]
                 scan = make_sharded_rle_scan(self.mesh, n_cols, self.max_codes, pad)
                 with _dispatch_lock:
                     # host arrays go in raw: the timed_dispatch seam
                     # ships them itself, so h2d bytes + transfer time
-                    # are measured where they happen
+                    # are measured where they happen; resident (device)
+                    # payloads ship nothing and are counted as such
                     masks, _totals = timed_dispatch(
                         "mesh_rle_scan", scan,
-                        values.reshape(self.w, self.r, n_cols, run_pad),
-                        lengths.reshape(self.w, self.r, n_cols, run_pad),
+                        dev_values,
+                        dev_lengths,
                         codes.reshape(self.w, self.r, n_cols, self.max_codes),
                         valid.reshape(self.w, self.r, pad),
                     )
                     masks_np = np.asarray(masks).reshape(cap, pad)
                 stats["units_runspace"] += len(live)
-                stats["h2d_bytes"] += (values.nbytes + lengths.nbytes
-                                       + codes.nbytes + valid.nbytes)
+                stats["h2d_bytes"] += codes.nbytes + valid.nbytes
+                if isinstance(dev_values, np.ndarray):
+                    stats["h2d_bytes"] += dev_values.nbytes + dev_lengths.nbytes
             else:
                 scan = self._scan(n_cols)
                 cols = np.zeros((cap, n_cols, pad), np.uint32)
@@ -543,7 +642,338 @@ class MeshSearcher:
         return resp
 
 
+    # -- batched multi-query search --------------------------------------
+    def search_blocks_multi(self, blocks, reqs, on_block_error=None,
+                            on_block_ok=None) -> list:
+        """N concurrent queries over the SAME block list, coalesced: each
+        (block, row-group) unit's rle run payload is stacked ONCE (or
+        served straight from the device-resident hot tier) and every
+        query's predicate set scans it in fused multi-query launches —
+        ceil(N / max_query_batch) dispatches per chunk instead of N.
+
+        Per-query semantics are bit-identical to N sequential
+        search_blocks calls: each query keeps its own predicate
+        resolution, zone pruning, time-window filter, attr/duration
+        post-filters, dedupe and limit. Units whose predicate pages are
+        not all-rle fall back to the host row-group scan per query.
+        Returns one SearchResponse per request, in order."""
+        import logging
+
+        from tempo_tpu.backend.faults import with_retries
+        from tempo_tpu.encoding.common import SearchResponse
+        from tempo_tpu.encoding.vtpu.block import (
+            _resolve_tag_predicates,
+            attr_predicate_mask,
+            pruned_row_groups_total,
+            zone_maps_enabled,
+            zone_prunes,
+        )
+        from tempo_tpu.encoding.vtpu.colcache import shared_device_tier
+
+        log = logging.getLogger(__name__)
+        reqs = list(reqs)
+        nq = len(reqs)
+        if nq == 0:
+            return []
+        if nq == 1:
+            return [self.search_blocks(blocks, reqs[0], on_block_error,
+                                       on_block_ok)]
+        zm = zone_maps_enabled()
+        tier = shared_device_tier()
+        batch = tier.max_query_batch if tier is not None else MAX_QUERY_BATCH
+        resps = [SearchResponse() for _ in reqs]
+        seen: list = [set() for _ in reqs]
+        hits: list = [[] for _ in reqs]
+        done = [False] * nq
+        opened: list = []
+        errors: list = []
+        cap = self.w * self.r
+        stats = self.last_stats = {
+            "dispatches": 0, "units_scanned": 0, "units_runspace": 0,
+            "h2d_bytes": 0, "d2h_bytes": 0, "collectives": 0,
+            "queries": nq, "query_lanes": 0,
+            "per_shard_rows": np.zeros(cap, np.int64),
+        }
+
+        def collect(q, blk, i, rg, preds, span_mask):
+            req = reqs[q]
+            have = {
+                name: self._col(blk, i, rg, name)
+                for name, _ in preds["span_eq"]
+                if blk.encoded_column(rg, name) is None
+            }
+            if preds["attr"]:
+                span_mask = span_mask & attr_predicate_mask(blk, rg, preds)
+            if req.min_duration_ns or req.max_duration_ns:
+                dur = blk.read_columns(rg, ["duration_nano"])["duration_nano"]
+                have["duration_nano"] = dur
+                if req.min_duration_ns:
+                    span_mask = span_mask & (dur >= np.uint64(req.min_duration_ns))
+                if req.max_duration_ns:
+                    span_mask = span_mask & (dur <= np.uint64(req.max_duration_ns))
+            if not span_mask.any():
+                return
+            for h in blk.hits_for_mask(rg, span_mask, req, 0, have_cols=have):
+                if h.trace_id_hex not in seen[q]:
+                    seen[q].add(h.trace_id_hex)
+                    hits[q].append(h)
+            if req.limit and len(seen[q]) >= req.limit:
+                done[q] = True
+
+        def host_unit(q, blk, i, rg, preds):
+            resps[q].inspected_traces += rg.n_traces
+            try:
+                rows = with_retries(
+                    lambda b=blk, r=rg, p=preds:
+                    list(b._search_row_group(r, reqs[q], p, limit=0)))
+                for h in rows:
+                    if h.trace_id_hex not in seen[q]:
+                        seen[q].add(h.trace_id_hex)
+                        hits[q].append(h)
+            except Exception as e:
+                errors.append((blk, e))
+                log.warning("mesh multi-search: row group scan failed: %s", e)
+            if reqs[q].limit and len(seen[q]) >= reqs[q].limit:
+                done[q] = True
+
+        def flush_multi(chunk):
+            # chunk: list of (blk, i, rg, preds_q, want) — preds_q is the
+            # per-query predicate resolution against this unit's block,
+            # want the per-query participation mask
+            if not chunk:
+                return
+            units = []  # device-eligible: (blk, i, rg, preds_q, want, encs, cols)
+            for blk, i, rg, preds_q, want in chunk:
+                cols: list = []  # first-seen-ordered union of constrained columns
+                for q in range(nq):
+                    if want[q]:
+                        for name, _ in preds_q[q]["span_eq"]:
+                            if name not in cols:
+                                cols.append(name)
+                encs = []
+                ok = True
+                for name in cols:
+                    enc = blk.encoded_column(rg, name)
+                    if enc is None or enc.codec != "rle":
+                        ok = False
+                        break
+                    encs.append(enc)
+                if ok:
+                    units.append((blk, i, rg, preds_q, want, encs, cols))
+                else:
+                    for q in range(nq):
+                        if want[q] and not done[q]:
+                            host_unit(q, blk, i, rg, preds_q[q])
+            if not units or all(done):
+                return
+            n_cols = max(1, max(len(u[6]) for u in units))
+            pad = self.bucket_for(max(u[2].n_spans for u in units))
+            pkeys = tuple(tuple(e.resident_key() for e in u[5]) for u in units)
+            skey = ("mesh_stack", pkeys, n_cols, pad)
+            res = tier.get(skey) if tier is not None else None
+            loaded = [True] * len(units)
+            if res is not None:
+                run_pad = int(res.meta["run_pad"])
+                dev_values = res.arrays["values"]
+                dev_lengths = res.arrays["lengths"]
+                tier.record_avoided(res.host_bytes, kernel="batched_rle_scan")
+            else:
+                max_runs = 8
+                unit_runs: list = []
+                for s, u in enumerate(units):
+                    blk, i, rg = u[0], u[1], u[2]
+                    try:
+                        runs = [with_retries(e.runs) for e in u[5]]
+                    except Exception as e:
+                        errors.append((blk, e))
+                        log.warning("mesh multi-search: run load failed: %s", e)
+                        unit_runs.append(None)
+                        loaded[s] = False
+                        continue
+                    unit_runs.append(runs)
+                    for v, l in runs:
+                        max_runs = max(max_runs, len(l))
+                run_pad = 1 << (max_runs - 1).bit_length()
+                values = np.full((cap, n_cols, run_pad), NO_MATCH, np.uint32)
+                lengths = np.zeros((cap, n_cols, run_pad), np.int32)
+                for s, u in enumerate(units):
+                    if unit_runs[s] is None:
+                        continue
+                    rg = u[2]
+                    for c, (v, l) in enumerate(unit_runs[s]):
+                        values[s, c, : len(v)] = v.astype(np.uint32)
+                        lengths[s, c, : len(l)] = l
+                    for c in range(len(u[6]), n_cols):
+                        values[s, c, 0] = 0
+                        lengths[s, c, 0] = rg.n_spans
+                dev_values = values.reshape(self.w, self.r, n_cols, run_pad)
+                dev_lengths = lengths.reshape(self.w, self.r, n_cols, run_pad)
+                pkeys_flat = [k for row in pkeys for k in row]
+                if tier is not None and all(loaded) and pkeys_flat:
+                    tier.offer(skey, "rle_stack",
+                               {"values": dev_values, "lengths": dev_lengths},
+                               meta={"run_pad": run_pad},
+                               host_bytes=values.nbytes + lengths.nbytes,
+                               page_keys=pkeys_flat)
+                    got = tier.get(skey)
+                    if got is not None:
+                        dev_values = got.arrays["values"]
+                        dev_lengths = got.arrays["lengths"]
+            valid = np.zeros((cap, pad), bool)
+            for s, u in enumerate(units):
+                if loaded[s]:
+                    valid[s, : u[2].n_spans] = True
+            scan = make_sharded_batched_rle_scan(
+                self.mesh, n_cols, self.max_codes, batch, pad)
+            shipped_payload = isinstance(dev_values, np.ndarray)
+            first_dispatch = True
+            for g0 in range(0, nq, batch):
+                lanes = [q for q in range(g0, min(g0 + batch, nq))]
+                if not any(not done[q] and any(u[4][q] for u in units)
+                           for q in lanes):
+                    continue  # every query in this group is done/absent
+                codes = np.full((cap, batch, n_cols, self.max_codes),
+                                NO_MATCH, np.uint32)
+                live = np.zeros((cap, batch, n_cols), bool)
+                for s, u in enumerate(units):
+                    if not loaded[s]:
+                        continue
+                    preds_q, want, cols = u[3], u[4], u[6]
+                    for j, q in enumerate(lanes):
+                        if not want[q] or done[q]:
+                            continue
+                        for name, accept in preds_q[q]["span_eq"]:
+                            c = cols.index(name)
+                            k = min(len(accept), self.max_codes)
+                            codes[s, j, c, :k] = accept[:k]
+                            live[s, j, c] = True
+                with _dispatch_lock:
+                    masks, _totals = timed_dispatch(
+                        "batched_rle_scan", scan,
+                        dev_values,
+                        dev_lengths,
+                        codes.reshape(self.w, self.r, batch, n_cols,
+                                      self.max_codes),
+                        live.reshape(self.w, self.r, batch, n_cols),
+                        valid.reshape(self.w, self.r, pad),
+                    )
+                    masks_np = np.asarray(masks).reshape(cap, batch, pad)
+                stats["dispatches"] += 1
+                stats["collectives"] += 1
+                active_lanes = sum(
+                    1 for q in lanes if not done[q]
+                    and any(u[4][q] for u in units))
+                stats["query_lanes"] += active_lanes
+                batched_lanes_total.inc(active_lanes)
+                stats["d2h_bytes"] += masks_np.nbytes
+                stats["h2d_bytes"] += codes.nbytes + live.nbytes
+                if first_dispatch:
+                    stats["h2d_bytes"] += valid.nbytes
+                    if shipped_payload:
+                        stats["h2d_bytes"] += (dev_values.nbytes
+                                               + dev_lengths.nbytes)
+                    stats["units_scanned"] += sum(loaded)
+                    stats["units_runspace"] += sum(loaded)
+                    stats["per_shard_rows"] += valid.sum(axis=1)
+                first_dispatch = False
+                for s, u in enumerate(units):
+                    if not loaded[s]:
+                        continue
+                    blk, i, rg, preds_q, want = u[0], u[1], u[2], u[3], u[4]
+                    for j, q in enumerate(lanes):
+                        if not want[q] or done[q]:
+                            continue
+                        resps[q].inspected_traces += rg.n_traces
+                        span_mask = masks_np[s, j, : rg.n_spans].copy()
+                        if not span_mask.any():
+                            continue
+                        try:
+                            with_retries(
+                                lambda qq=q, b=blk, jj=i, r=rg,
+                                p=preds_q[q], m=span_mask:
+                                collect(qq, b, jj, r, p, m))
+                        except Exception as e:
+                            errors.append((blk, e))
+                            log.warning(
+                                "mesh multi-search: hit collection failed: %s", e)
+                if all(done):
+                    return
+
+        pending: list = []
+        for blk in blocks:
+            if all(done):
+                break
+            opened.append(blk)
+            for resp in resps:
+                resp.inspected_blocks += 1
+            try:
+                dic = with_retries(blk.dictionary)
+                preds_q = [_resolve_tag_predicates(r, dic) for r in reqs]
+                if all(p is None for p in preds_q):
+                    continue  # impossible for every query: no more IO
+                row_groups = list(with_retries(blk.index).row_groups)
+            except Exception as e:
+                errors.append((blk, e))
+                log.warning("mesh multi-search: block %s unreadable: %s",
+                            blk.meta.block_id, e)
+                continue
+            for i, rg in enumerate(row_groups):
+                want = []
+                for q, (req, p) in enumerate(zip(reqs, preds_q)):
+                    w = p is not None and not done[q]
+                    if w and req.start_seconds and rg.end_s < req.start_seconds:
+                        w = False
+                    if w and req.end_seconds and rg.start_s > req.end_seconds:
+                        w = False
+                    if w and zm and zone_prunes(rg, p, req):
+                        resps[q].pruned_row_groups += 1
+                        self.pruned_row_groups += 1
+                        pruned_row_groups_total.inc()
+                        w = False
+                    want.append(w)
+                if not any(want):
+                    continue
+                pending.append((blk, i, rg, preds_q, want))
+                if len(pending) >= cap:
+                    flush_multi(pending)
+                    pending = []
+                    if all(done):
+                        break
+        if not all(done):
+            flush_multi(pending)
+
+        from tempo_tpu.backend.base import NotFound
+
+        failed: dict = {}
+        for bad_blk, e in errors:
+            failed.setdefault(bad_blk.meta.block_id, e)
+        for b in opened:
+            bid = b.meta.block_id
+            if bid in failed:
+                if on_block_error is not None and not isinstance(
+                        failed[bid], NotFound):
+                    on_block_error(bid, failed[bid])
+            elif on_block_ok is not None:
+                on_block_ok(bid)
+        fatal = [e for _, e in errors if not isinstance(e, NotFound)]
+        if fatal:
+            raise fatal[0]
+
+        inspected = sum(b.bytes_read for b in opened)
+        decoded = sum(getattr(b, "decoded_bytes", 0) for b in opened)
+        coalesced = sum(getattr(b, "coalesced_reads", 0) for b in opened)
+        for q, resp in enumerate(resps):
+            hits[q].sort(key=lambda t: -t.start_time_unix_nano)
+            resp.traces = (hits[q][: reqs[q].limit]
+                           if reqs[q].limit else hits[q])
+            resp.inspected_bytes = inspected
+            resp.decoded_bytes = decoded
+            resp.coalesced_reads = coalesced
+        return resps
+
+
 NO_MATCH = np.uint32(0xFFFFFFFF)
+MAX_QUERY_BATCH = 8  # query lanes per fused multi-query dispatch (default)
 
 
 def pack_predicates(code_sets: list[np.ndarray], max_codes: int) -> np.ndarray:

@@ -71,6 +71,12 @@ transfer_bytes_total = metrics.counter(
     "Bytes crossing (h2d|d2h) or parked at (resident) the host<->device "
     "boundary per host-level dispatch, by direction and kernel label",
 )
+transfer_avoided_bytes_total = metrics.counter(
+    "tempo_tpu_device_transfer_bytes_avoided_total",
+    "H2D bytes NOT moved because the scan was served from the "
+    "device-resident hot tier (what the host path would have shipped), "
+    "by kernel label — the hot tier's measured win",
+)
 
 # jax import hoisted out of the dispatch hot path: resolved once, kept
 # lazy so processes that never dispatch (a pure distributor) don't pay
@@ -105,6 +111,20 @@ def count_transfer(kernel: str, h2d: int = 0, d2h: int = 0,
         usage.charge("transfer_bytes", moved)
 
 
+def count_avoided(kernel: str, nbytes: int) -> None:
+    """One resident-tier serve elided `nbytes` of h2d. Avoided bytes are
+    the counterfactual (what the host path WOULD have shipped) — kept in
+    their own counter, never mixed into the movement totals, so the
+    exactness contract on transfer_bytes stays bit-true."""
+    if nbytes:
+        transfer_avoided_bytes_total.inc(nbytes, kernel=kernel)
+
+
+def avoided_total() -> float:
+    """Lifetime h2d bytes the hot tier elided."""
+    return transfer_avoided_bytes_total.total()
+
+
 def moved_total() -> float:
     """Untagged bytes actually moved (h2d + d2h; resident excluded) —
     what the per-tenant `transfer_bytes` vectors must sum to."""
@@ -125,7 +145,12 @@ def transfer_report() -> dict:
         totals[d] += int(v)
     return {
         "byKernel": by_kernel,
-        "totals": {**totals, "moved": totals["h2d"] + totals["d2h"]},
+        "totals": {**totals, "moved": totals["h2d"] + totals["d2h"],
+                   "avoided": int(avoided_total())},
+        "avoidedByKernel": {
+            labels.get("kernel", ""): int(v)
+            for labels, v in transfer_avoided_bytes_total.series()
+        },
         "dispatchesByKernel": {
             labels.get("kernel", ""): int(v)
             for labels, v in dispatch_total.series()

@@ -450,9 +450,102 @@ def what_if_report(ledger: PageHeatLedger | None = None,
     }
 
 
+# ---------------------------------------------------------------------------
+# admission API: the closed loop the device-resident hot tier consumes
+# ---------------------------------------------------------------------------
+
+
+def knee_budget(curve: list) -> int:
+    """Budget at the KNEE of a what-if curve (rows with `budgetBytes`
+    and `savedBytes`): the point of maximum vertical distance between
+    the normalized saved-bytes curve and the straight chord from the
+    smallest to the largest budget — past the knee each extra HBM byte
+    buys less than the average byte did, so pinning beyond it trades
+    headroom for a flattening return. Returns 0 when the curve saves
+    nothing anywhere (a cold ledger must admit nothing)."""
+    rows = [r for r in curve if r.get("budgetBytes", 0) > 0]
+    if not rows:
+        return 0
+    max_saved = max(int(r.get("savedBytes", 0)) for r in rows)
+    if max_saved <= 0:
+        return 0
+    max_budget = max(int(r["budgetBytes"]) for r in rows)
+    best, best_d = 0, float("-inf")
+    for r in rows:
+        d = (int(r.get("savedBytes", 0)) / max_saved
+             - int(r["budgetBytes"]) / max_budget)
+        # ties break toward the SMALLER budget (strict >): same savings
+        # for less HBM
+        if d > best_d:
+            best_d, best = d, int(r["budgetBytes"])
+    return best
+
+
+def admission_candidates(budget_bytes: int,
+                         ledger: PageHeatLedger | None = None,
+                         min_ships: int = 2,
+                         tenant_weights: dict | None = None) -> list:
+    """The pages the hot tier SHOULD hold at `budget_bytes`: ledger
+    entries ranked by re-ship bytes (optionally weighted by the
+    per-tenant scan-cost vectors — a tenant whose scans dominate the
+    bill pulls its pages up), greedily packed by encoded (pinned) size.
+    Pages that shipped fewer than `min_ships` times, or whose re-ship
+    total never exceeded their pinned cost, are never worth a slot.
+
+    Returns [{"block", "column", "offset", "ships", "movedBytes",
+    "encodedBytes"}] hottest-first; the tier treats membership as its
+    admission set."""
+    ledger = ledger or LEDGER
+    with ledger._lock:
+        entries = {k: list(e) for k, e in ledger._entries.items()}
+    rows = []
+    for k, e in entries.items():
+        ships, moved, enc = e[0], e[1], e[2]
+        if ships < min_ships or enc <= 0 or moved <= enc:
+            continue
+        w = 1.0
+        if tenant_weights:
+            w = float(tenant_weights.get(k[0], tenant_weights.get("*", 1.0)))
+        rows.append((moved * w, k, ships, moved, enc))
+    rows.sort(key=lambda r: -r[0])
+    out, pinned = [], 0
+    for _w, k, ships, moved, enc in rows:
+        if pinned + enc > budget_bytes:
+            continue  # keep packing: a smaller page may still fit
+        pinned += enc
+        out.append({
+            "block": k[0], "column": k[1], "offset": k[2],
+            "ships": ships, "movedBytes": moved, "encodedBytes": enc,
+        })
+    return out
+
+
+def admission_report(budget_bytes: int | None = None,
+                     ledger: PageHeatLedger | None = None,
+                     min_ships: int = 2) -> dict:
+    """One admission decision, explained: the what-if knee, the
+    effective budget (knee capped by the configured tier budget when
+    given), and the candidate set at that budget — what `cli analyse
+    device --resident` and the tier's refresh both read."""
+    ledger = ledger or LEDGER
+    report = what_if_report(ledger=ledger)
+    knee = knee_budget(report["curve"])
+    effective = knee if budget_bytes is None else min(knee, int(budget_bytes))
+    cands = admission_candidates(effective, ledger=ledger, min_ships=min_ships)
+    return {
+        "kneeBudgetBytes": knee,
+        "configuredBudgetBytes": budget_bytes,
+        "effectiveBudgetBytes": effective,
+        "candidates": cands,
+        "candidateBytes": sum(c["encodedBytes"] for c in cands),
+    }
+
+
 def device_report(budgets_bytes: list | None = None, top: int = 50) -> dict:
     """The /status/device document: transfer counters + hot-set report +
-    what-if miss-ratio curve, one correlated view of data movement."""
+    what-if miss-ratio curve + the resident hot tier's actual state,
+    one correlated view of data movement."""
+    from tempo_tpu.encoding.vtpu import colcache
     from tempo_tpu.util import devicetiming
 
     return {
@@ -460,6 +553,7 @@ def device_report(budgets_bytes: list | None = None, top: int = 50) -> dict:
         "pageHeat": LEDGER.snapshot(top=top),
         "whatIf": what_if_report(budgets_bytes=budgets_bytes,
                                  publish_gauges=budgets_bytes is None),
+        "residentTier": colcache.device_tier_report(),
     }
 
 
@@ -517,6 +611,8 @@ class PageHeatExporter:
         """Self-contained snapshot: ledger rollup + what-if curve + the
         raw access stream (key-interned), so offline analysis can re-run
         the simulation at different budgets."""
+        from tempo_tpu.encoding.vtpu import colcache
+
         stream = LEDGER.access_stream()[-self._EXPORT_STREAM_CAP:]
         keys = LEDGER.key_table()
         used = sorted({kid for kid, _, _ in stream})
@@ -526,6 +622,7 @@ class PageHeatExporter:
             "seq": LEDGER.mark(),
             "pageHeat": LEDGER.snapshot(top=200),
             "whatIf": what_if_report(publish_gauges=True),
+            "residentTier": colcache.device_tier_report(),
             "keys": [list(keys.get(kid, ("?", "?", -1))) for kid in used],
             "stream": [[index[kid], enc, mv] for kid, enc, mv in stream],
         }

@@ -131,24 +131,28 @@ class Proc:
 
 
 def start_cluster(tmp: str, grpc_port: int = 0,
-                  multitenant: bool = False) -> tuple[list[Proc], Proc, Proc]:
+                  multitenant: bool = False,
+                  extra: str = "") -> tuple[list[Proc], Proc, Proc]:
     """-> (all procs, frontend/query entry, distributor entry).
 
     The frontend hosts the ring KV service ("local") and every other
     role joins through it — the same bootstrap the multi-process e2e
-    test uses."""
+    test uses. `extra` is appended to every process's config (the --hot
+    arm uses it to enable the device-resident tier fleet-wide)."""
     front = Proc(tmp, "query-frontend", "front", kv_url="local",
-                 multitenant=multitenant)
+                 multitenant=multitenant, extra=extra)
     front.wait_ready()
     kv_url = front.url
     procs = [front]
-    procs.append(Proc(tmp, "ingester", "ing-a", kv_url, multitenant=multitenant))
-    procs.append(Proc(tmp, "ingester", "ing-b", kv_url, multitenant=multitenant))
+    procs.append(Proc(tmp, "ingester", "ing-a", kv_url, multitenant=multitenant,
+                      extra=extra))
+    procs.append(Proc(tmp, "ingester", "ing-b", kv_url, multitenant=multitenant,
+                      extra=extra))
     dist = Proc(tmp, "distributor", "dist", kv_url, grpc_port=grpc_port,
-                multitenant=multitenant)
+                multitenant=multitenant, extra=extra)
     procs.append(dist)
     procs.append(Proc(tmp, "querier", "querier", kv_url,
-                      extra=f"frontend_address: {kv_url}\n",
+                      extra=f"frontend_address: {kv_url}\n" + extra,
                       multitenant=multitenant))
     for p in procs[1:]:
         p.wait_ready()
@@ -946,6 +950,143 @@ def device_transfer_check(urls: list, retries: int = 3) -> dict:
     return last
 
 
+# ---------------------------------------------------------------------------
+# --hot arm: repeat-query live-tail/recent-window workload against the
+# device-resident tier (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+# device-tier config appended to every process config in --hot mode:
+# small budget, 1s admission refresh so a short smoke crosses
+# min_ships -> candidate -> admitted inside the run.
+HOT_TIER_EXTRA = """device_tier:
+  budget_mb: 64
+  refresh_s: 1.0
+  admit_min_ships: 2
+"""
+
+
+def _scrape_hot(urls: list) -> dict:
+    """Sum the hot-tier gate's metric families across processes."""
+    out = {"h2d_bytes": 0.0, "device_hits": 0.0, "avoided_bytes": 0.0,
+           "stage_transfer_s": 0.0, "stage_kernel_s": 0.0, "dispatches": 0.0}
+    for _name, url in urls:
+        try:
+            with urllib.request.urlopen(url + "/metrics", timeout=15) as r:
+                met = r.read().decode()
+        except Exception:  # noqa: BLE001 — a dead proc fails the gates anyway
+            continue
+        for line in met.splitlines():
+            try:
+                val = float(line.rsplit(" ", 1)[1])
+            except (ValueError, IndexError):
+                continue
+            if (line.startswith("tempo_tpu_device_transfer_bytes_total")
+                    and 'direction="h2d"' in line):
+                out["h2d_bytes"] += val
+            elif (line.startswith("tempo_tpu_colcache_hits")
+                    and 'tier="device"' in line):
+                out["device_hits"] += val
+            elif line.startswith("tempo_tpu_device_transfer_bytes_avoided_total"):
+                out["avoided_bytes"] += val
+            elif line.startswith("tempo_tpu_query_stage_seconds_sum"):
+                if 'stage="transfer"' in line:
+                    out["stage_transfer_s"] += val
+                elif 'stage="kernel"' in line:
+                    out["stage_kernel_s"] += val
+            elif line.startswith("tempo_tpu_device_dispatches_total"):
+                out["dispatches"] += val
+    return out
+
+
+def hot_tier_probe(query_url: str, scrape_urls: list, iters: int = 8,
+                   warm_timeout_s: float = 60.0,
+                   transfer_frac: float = 0.5) -> dict:
+    """Repeat-query arm: fire the SAME recent-window search (identical
+    page set) until hot pages are admitted to the device tier, then
+    measure a hot window of `iters` repeats and gate on:
+
+    - resident-tier hits climbing while `tempo_tpu_device_transfer_bytes_total`
+      (h2d) stays flat — repeats stop re-shipping compressed pages,
+    - transfer-stage seconds below `transfer_frac` of kernel-stage
+      seconds over the hot window (only gated when the window actually
+      dispatched device work),
+    - transfer bytes AVOIDED climbing (the ledger credits each resident
+      serve with the ship it didn't do).
+    """
+    from tempo_tpu.model import synth
+
+    # pick a service that actually matches flushed data, then FREEZE the
+    # query so every repeat touches the identical page set. synth traces
+    # are pinned at a fixed epoch, so the window brackets that epoch —
+    # a now-window would miss every flushed block.
+    base_s = 1_700_000_000
+    qs = None
+    for svc in synth.SERVICES:
+        cand = urllib.parse.urlencode({
+            "tags": f"service.name={svc}",
+            "start": base_s - 300, "end": base_s + 300, "limit": 20})
+        try:
+            doc = _get_json(f"{query_url}/api/search?{cand}", timeout=30)
+        except Exception:  # noqa: BLE001
+            continue
+        if doc.get("traces"):
+            qs = cand
+            break
+    if qs is None:
+        return {"error": "no service with searchable traces", "passed": False}
+
+    def fire():
+        try:
+            _get_json(f"{query_url}/api/search?{qs}", timeout=30)
+        except Exception:  # noqa: BLE001 — gates read the counters
+            pass
+
+    base = _scrape_hot(scrape_urls)
+    # warm phase: repeat until the tier starts serving hits (ship ->
+    # heat -> admission needs min_ships repeats + one refresh interval)
+    deadline = time.time() + warm_timeout_s
+    warm_iters = 0
+    while time.time() < deadline:
+        fire()
+        warm_iters += 1
+        if _scrape_hot(scrape_urls)["device_hits"] > base["device_hits"]:
+            break
+        time.sleep(0.4)
+    mid = _scrape_hot(scrape_urls)
+    for _ in range(iters):
+        fire()
+    after = _scrape_hot(scrape_urls)
+
+    hot = {k: after[k] - mid[k] for k in after}
+    warm = {k: mid[k] - base[k] for k in mid}
+    hits_climb = hot["device_hits"] > 0
+    avoided_climb = hot["avoided_bytes"] > 0
+    # flat = repeats stopped re-shipping pages: per-dispatch predicate
+    # codes (tens of bytes) still ship, so "flat" is a tight per-iter
+    # allowance, not literal zero
+    h2d_flat = hot["h2d_bytes"] <= max(4096.0 * iters,
+                                       0.05 * max(warm["h2d_bytes"], 0.0))
+    if hot["dispatches"] > 0:
+        transfer_ok = hot["stage_transfer_s"] <= max(
+            transfer_frac * hot["stage_kernel_s"], 0.005)
+    else:
+        transfer_ok = False  # hot window never reached the device path
+    return {
+        "warm_iters": warm_iters,
+        "hot_iters": iters,
+        "warm": warm,
+        "hot": hot,
+        "gates": {
+            "device_hits_climb": hits_climb,
+            "avoided_bytes_climb": avoided_climb,
+            "h2d_flat": h2d_flat,
+            "transfer_below_kernel": transfer_ok,
+        },
+        "passed": bool(hits_climb and avoided_climb and h2d_flat
+                       and transfer_ok),
+    }
+
+
 def storage_summary(query_url: str) -> dict:
     """Fleet storage health from the frontend's /status/storage — the
     same compression/debt/zone-map numbers bench_suite emits, so CI
@@ -1115,6 +1256,13 @@ def main() -> int:
                          "inspected spans == cut delta (O(delta)), (ii) zero "
                          "standing-read dips during handoff, (iii) usage "
                          "exactness for kind 'standing'")
+    ap.add_argument("--hot", type=int, default=0, metavar="N",
+                    help="enable the device-resident hot tier fleet-wide "
+                         "and run a repeat-query arm after the drain: the "
+                         "same recent-window search repeated until pages "
+                         "are admitted, then N hot repeats gated on "
+                         "resident hits climbing, h2d transfer bytes flat, "
+                         "and transfer-stage time < half of kernel time")
     ap.add_argument("--tenants", type=int, default=1,
                     help=">1 enables multi-tenant mode: the cluster boots "
                          "with multitenancy, every op carries one of N org "
@@ -1138,8 +1286,9 @@ def main() -> int:
             write_url = query_url = args.url
         else:
             tmpdir = tempfile.mkdtemp(prefix="tempo-loadtest-")
-            procs, front, dist = start_cluster(tmpdir, grpc_port=grpc_port,
-                                               multitenant=multitenant)
+            procs, front, dist = start_cluster(
+                tmpdir, grpc_port=grpc_port, multitenant=multitenant,
+                extra=HOT_TIER_EXTRA if args.hot > 0 else "")
             write_url, query_url = dist.url, front.url
             print(f"[loadtest] cluster up: write={write_url} query={query_url}"
                   + (f" tenants={args.tenants}" if multitenant else ""),
@@ -1226,6 +1375,13 @@ def main() -> int:
         device_ok = summary["device_transfer"]["passed"]
         print(f"[loadtest] device-transfer gate: {summary['device_transfer']}",
               file=sys.stderr)
+        hot_ok = True
+        if args.hot > 0:
+            summary["hot_tier"] = hot_tier_probe(query_url, check_urls,
+                                                 iters=args.hot)
+            hot_ok = summary["hot_tier"]["passed"]
+            print(f"[loadtest] hot-tier gate: {summary['hot_tier']}",
+                  file=sys.stderr)
         summary["passed"] = bool(
             summary["slo_pass"]
             and loss["passed"]
@@ -1234,6 +1390,7 @@ def main() -> int:
             and vulture_ok
             and standing_ok
             and device_ok
+            and hot_ok
             and (rss is None or summary["rss"]["passed"])
         )
         print(json.dumps(summary))
