@@ -858,6 +858,119 @@ def _hot_tier_rep(reps: int = 3) -> dict:
         tmp.cleanup()
 
 
+def _compiled_rep(reps: int = 3) -> dict:
+    """Compiled-query tier rep (BENCH_r07, ISSUE 17): repeated
+    query_range over the same stored blocks, `interpreted` arm
+    (TEMPO_TPU_COMPILED=0: the per-stage dispatch tax every run) vs
+    `compiled` arm (the shape-keyed fused program: one launch per codec
+    group, literal swaps re-entering the traced executable). The JSON
+    carries per-arm p50 seconds and DEVICE DISPATCHES PER QUERY so the
+    acceptance claims — O(1) dispatches, p50 down vs the interpreter —
+    are inspectable numbers; literals rotate between reps to defeat any
+    literal-level caching while keeping the shape hot, and zero retrace
+    across the rotation is checked via the compiles counter.
+
+    Read the ratio against the platform: on CPU both arms run host-speed
+    numpy/XLA and per-dispatch framework overhead is the whole compiled
+    cost, so interpreted_vs_compiled hovers near or below 1 — the
+    dispatch-count and retrace columns are the acceptance signal there.
+    On an accelerator every interpreter stage is a real device round
+    trip, which is the tax the single fused launch removes."""
+    from tempo_tpu.backend import MockBackend
+    from tempo_tpu.compiled import cache as compiled_cache
+    from tempo_tpu.db import DBConfig, TempoDB
+    from tempo_tpu.encoding.vtpu import colcache
+    from tempo_tpu.model import synth
+    from tempo_tpu.model import trace as tr
+    from tempo_tpu.modules.querier import Querier
+    from tempo_tpu.util.devicetiming import dispatch_total
+
+    # production-shaped inputs: the interpreter pays per (row group x
+    # stage) dispatch, the compiled arm one launch per codec group —
+    # tiny blocks would only measure the jit call overhead
+    db = TempoDB(DBConfig(backend="mock"), raw_backend=MockBackend())
+    for i in range(8):
+        ts = synth.make_traces(1500, seed=700 + i, spans_per_trace=8)
+        db.write_batch("t", tr.traces_to_batch(ts).sorted_by_trace())
+    metas = list(db.blocklist.metas("t"))
+    ids = [m.block_id for m in metas]
+    qr = Querier(db)
+    start, end, step = 1_700_000_000, 1_700_000_060, 10
+    literals = ("cart", "checkout", "frontend")
+    queries = {
+        "service_eq": "{ resource.service.name = `%s` } | rate()",
+        "service+duration":
+            "{ resource.service.name = `%s` && duration > 100us } | rate()",
+    }
+
+    def run_once(qtpl: str, lit: str, compiled_on: bool) -> dict:
+        if not compiled_on:
+            os.environ["TEMPO_TPU_COMPILED"] = "0"
+        try:
+            return qr.query_range_blocks(
+                "t", ids, qtpl % lit, start, end, step)
+        finally:
+            os.environ.pop("TEMPO_TPU_COMPILED", None)
+
+    out: dict = {}
+    parity_all = True
+    # the designed deployment parks the query-independent page stacks on
+    # the device tier (compiled_stack keys): repeats ship zero payload.
+    # Admission forced open as in the hot-tier rep — policy has tests.
+    old_tier = colcache._shared_device
+    tier = colcache.DeviceTier(128 << 20, refresh_s=3600.0)
+    tier.should_admit = lambda page_keys: True
+    colcache._shared_device = tier
+    try:
+        for qname, qtpl in queries.items():
+            compiled_cache.shape_cache().clear()
+            # warm both arms: jit traces + stack offers + page cache out
+            # of the clock
+            run_once(qtpl, literals[0], True)
+            run_once(qtpl, literals[0], False)
+            compiles0 = compiled_cache.shape_cache().stats()["compiles"]
+            t_c, t_i = [], []
+            disp = {"compiled": 0.0, "interpreted": 0.0}
+            n_queries = 0
+            for r in range(reps):
+                for lit in literals:
+                    d0 = dispatch_total.total()
+                    t0 = time.perf_counter()
+                    wc = run_once(qtpl, lit, True)
+                    t_c.append(time.perf_counter() - t0)
+                    d1 = dispatch_total.total()
+                    t0 = time.perf_counter()
+                    wi = run_once(qtpl, lit, False)
+                    t_i.append(time.perf_counter() - t0)
+                    disp["compiled"] += d1 - d0
+                    disp["interpreted"] += dispatch_total.total() - d1
+                    n_queries += 1
+                    if wc["series"] != wi["series"]:
+                        parity_all = False
+                        print(f"[bench] WARNING: compiled rep {qname!r} "
+                              "arms DISAGREE", file=sys.stderr)
+            retraces = (compiled_cache.shape_cache().stats()["compiles"]
+                        - compiles0)
+            paired = float(np.median([i / c for i, c in zip(t_i, t_c)]))
+            out[qname] = {
+                "compiled_p50_s": round(float(np.median(t_c)), 4),
+                "interpreted_p50_s": round(float(np.median(t_i)), 4),
+                "interpreted_vs_compiled": round(paired, 3),
+                "dispatches_per_query": {
+                    k: round(v / max(n_queries, 1), 2)
+                    for k, v in disp.items()},
+                "retraces_after_warm": int(retraces),  # 0 = swaps free
+            }
+            if retraces:
+                print(f"[bench] WARNING: compiled rep {qname!r} retraced "
+                      f"{retraces}x on literal swaps", file=sys.stderr)
+    finally:
+        colcache._shared_device = old_tier
+    out["parity"] = parity_all
+    out["cache"] = compiled_cache.shape_cache().stats()
+    return out
+
+
 def _decode_rep(reps: int = 5) -> dict:
     """Per-codec decode throughput (MB/s of DECODED payload): the host
     entropy tier (zstd_shuffle via the native lib, zlib fallback) vs the
@@ -1143,6 +1256,16 @@ def main():
         child_server()
         return
 
+    if "compiled" in sys.argv[1:]:
+        # standalone compiled-tier rep (BENCH_r07 fields): interpreted
+        # vs compiled arms with dispatches-per-query and p50, without
+        # the headline compaction workload — for CI and hand-runs
+        _setup_jax()
+        rep = _compiled_rep()
+        print(f"[bench] compiled: {rep}", file=sys.stderr)
+        print(json.dumps({"compiled": rep}))
+        return
+
     # faults-off guard: perf numbers must measure the real path. A chaos
     # plan left armed in the environment would silently skew (or crash)
     # every rep, so refuse to run rather than emit a poisoned artifact.
@@ -1303,6 +1426,12 @@ def _run(dog, partial: dict):
     partial["hot_tier"] = hot_tier_rep
     print(f"[bench] hot_tier: {hot_tier_rep}", file=sys.stderr)
 
+    # compiled-query tier: fused shape-keyed programs vs the interpreted
+    # per-stage dispatch path (ISSUE 17 tentpole / BENCH_r07 fields)
+    compiled_rep = _compiled_rep()
+    partial["compiled"] = compiled_rep
+    print(f"[bench] compiled: {compiled_rep}", file=sys.stderr)
+
     med, spread = _stats(tpu_times)
     blocks_per_s = B_BLOCKS / med
     # paired per-rep ratios: epoch noise hits both arms of a pair, so the
@@ -1350,6 +1479,7 @@ def _run(dog, partial: dict):
         "graph": graph_rep,
         "standing": standing_rep,
         "hot_tier": hot_tier_rep,
+        "compiled": compiled_rep,
     }))
 
 

@@ -390,9 +390,16 @@ class _Handler(BaseHTTPRequestHandler):
                 limit = int(qs.get("limit", ["50"])[0])
             except ValueError as e:
                 raise BadRequest(f"bad limit: {e}") from e
+            from tempo_tpu.compiled import cache as compiled_cache
+
             self._send_json(200, {
                 "tenant": tenant,
                 "insights": insights_mod.LOG.snapshot(tenant, limit=limit),
+                # executable-cache rollup for the compiledShape field on
+                # the records above: shapes/programs cached, hit ratio,
+                # compile + eviction counts (runbook: "Reading the
+                # compiled-query tier")
+                "compiled": compiled_cache.shape_cache().stats(),
             })
             return 200
         if path == api_params.PATH_ECHO:

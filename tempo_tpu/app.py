@@ -15,6 +15,8 @@ from __future__ import annotations
 import logging
 from dataclasses import dataclass, field
 
+from tempo_tpu.compiled import CompiledConfig
+from tempo_tpu.compiled import configure as configure_compiled
 from tempo_tpu.db import DBConfig, TempoDB
 from tempo_tpu.encoding.common import SearchRequest
 from tempo_tpu.encoding.vtpu.colcache import DeviceTierConfig, configure_device_tier
@@ -117,6 +119,10 @@ class AppConfig:
     # budget_mb > 0 pins the hottest compressed pages in accelerator
     # memory; scans over them skip fetch+decode+h2d entirely
     device_tier: "DeviceTierConfig" = field(default_factory=DeviceTierConfig)
+    # compiled-query tier (tempo_tpu/compiled): shape-keyed fused device
+    # programs for simple-count metrics plans; kill switch
+    # TEMPO_TPU_COMPILED=0 or compiled.enabled=false
+    compiled: "CompiledConfig" = field(default_factory=CompiledConfig)
 
 
 class RoleUnavailable(RuntimeError):
@@ -136,6 +142,9 @@ class App:
         # install (or disable) the device-resident hot tier; it binds to
         # the governor lazily, so order relative to configure() is free
         configure_device_tier(cfg.device_tier)
+        # apply the compiled-tier section (and register its counters on
+        # the boot path, so /metrics exposes them before the first query)
+        configure_compiled(cfg.compiled)
         target = cfg.target or "all"
         if target not in ROLES:
             raise ValueError(f"unknown target {target!r} (have {ROLES})")

@@ -1,0 +1,25 @@
+"""Compiled-query tier: shape-keyed fused device programs.
+
+One normalized query shape (util/queryshape) -> one lowering verdict;
+one static signature (codec mix, pad widths) -> ONE jitted program
+whose literals and time bounds are runtime arguments. A repeated-shape
+dashboard load therefore pays tracing once and thereafter runs a
+single fused dispatch per codec group — the interpreter's per-stage,
+per-row-group dispatch train collapses to O(1) device launches per
+query. Kill switch: TEMPO_TPU_COMPILED=0 (results are bit-identical
+either way; the tier only changes WHERE the counting happens).
+"""
+
+from tempo_tpu.compiled.cache import (  # noqa: F401
+    CompiledConfig,
+    ShapeCache,
+    configure,
+    enabled,
+    shape_cache,
+)
+from tempo_tpu.compiled.executor import (  # noqa: F401
+    observe_search_shape,
+    try_query_range,
+    try_query_range_many,
+)
+from tempo_tpu.compiled.lower import lower_metrics_plan  # noqa: F401

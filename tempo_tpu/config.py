@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 import yaml
 
 from tempo_tpu.app import AppConfig
+from tempo_tpu.compiled import CompiledConfig
 from tempo_tpu.db import DBConfig
 from tempo_tpu.encoding.vtpu.colcache import DeviceTierConfig
 from tempo_tpu.db.compaction import CompactionConfig
@@ -199,6 +200,10 @@ def parse_config(text: str, env: dict | None = None) -> Config:
     # device-resident hot tier (budget_mb=0 disables)
     app.device_tier = _from_dict(
         DeviceTierConfig, doc.pop("device_tier", None), "device_tier")
+    # compiled-query tier (shape-keyed fused programs; enabled=false or
+    # TEMPO_TPU_COMPILED=0 routes every query to the interpreter)
+    app.compiled = _from_dict(
+        CompiledConfig, doc.pop("compiled", None), "compiled")
     # burn-rate SLO engine; objectives is a LIST of dataclasses, handled
     # like distributor.forwarders
     slo_doc = doc.pop("slo", {}) or {}
@@ -395,6 +400,27 @@ def check_config(cfg: Config) -> list[str]:
                 f"({app.device_tier.budget_mb} MB): an inverted cache "
                 "hierarchy — every device admission rebuilds its payload "
                 "through a host tier too small to hold it"
+            )
+    # -- compiled-query tier ----------------------------------------------
+    if app.compiled.enabled and app.multitenancy_enabled \
+            and app.compiled.max_shapes <= 0:
+        warnings.append(
+            "compiled.max_shapes is unset in a multitenant cluster: query "
+            "text is tenant-controlled, so distinct literal-stripped shapes "
+            "— and the jitted programs behind them — can grow without bound "
+            "(set the cap; the LRU keeps hot dashboards compiled)"
+        )
+    if app.compiled.enabled and app.device_tier.budget_mb > 0:
+        from tempo_tpu.encoding.vtpu.colcache import hbm_headroom_bytes as _hbm
+
+        headroom = _hbm()
+        if 0 < headroom < (app.device_tier.budget_mb << 20):
+            warnings.append(
+                "compiled tier enabled while device_tier.budget_mb exceeds "
+                "detected accelerator memory: the tier's stacked page sets "
+                "and cached executables compete for HBM the page budget "
+                "already oversubscribes — shrink the budget below the "
+                "headroom before enabling compiled execution"
             )
     if app.slo.enabled:
         for obj in (app.slo.objectives or slo_mod.default_objectives()):

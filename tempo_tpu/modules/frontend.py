@@ -484,6 +484,14 @@ class Frontend:
         if group:
             descs.append({"kind": "search_blocks", "block_ids": group, "search": req.to_dict()})
 
+        if any(d["kind"] == "search_blocks" for d in descs):
+            # search executes through the PR 16 fused batched scans
+            # whose jit caches are shape-keyed already; the compiled
+            # tier's contribution here is the shape ledger — hit/miss
+            # counters and the per-query compiledShape verdict
+            from tempo_tpu import compiled
+            insights.note(compiledShape=compiled.observe_search_shape(req))
+
         with self._admit(tenant, est_bytes, protected=protected, what="search"):
             results, errors = self._run_jobs(tenant, descs)
         failed = self._settle(tenant, len(descs), results, errors)
@@ -592,10 +600,21 @@ class Frontend:
         # response partial with an exact failed-shard count
         failed = self._settle(tenant, len(descs), results, errors)
         merged = new_wire()
+        shapes = []
         with stagetimings.stage("merge"):
             for r in results:
                 off = (int(r.get("start", plan.start_s)) - plan.start_s) // plan.step_s
                 merge_wire(merged, r.get("wire", {}), plan, bin_offset=off)
+                cs = r.get("wire", {}).get("compiledShape")
+                if cs:
+                    shapes.append(cs)
+        if shapes:
+            # per-query verdict for the insights record: worst shard
+            # wins (one interpreter shard means the query didn't fully
+            # ride the compiled tier); recent-window jobs carry no
+            # verdict — live segments aren't block work
+            rank = {"hit": 0, "miss": 1, "fallback": 2}
+            insights.note(compiledShape=max(shapes, key=lambda s: rank.get(s, 2)))
         if len(results) > 1 and merged["stats"].get("seriesDropped"):
             # each shard caps series in its own first-seen order, so a
             # series kept by one shard and dropped by another would read

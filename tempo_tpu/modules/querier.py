@@ -288,6 +288,18 @@ class Querier:
                     sp_rule = None  # legacy store: keep the device path
             except Exception:
                 log.exception("step-partial probe failed; using span path")
+        # compiled tier (tempo_tpu/compiled): a simple-count plan whose
+        # filters flatten to per-column predicates runs as ONE fused
+        # jitted program over the whole block batch — shape-cached, so
+        # repeated dashboard shapes skip tracing entirely. The
+        # step-partial tier outranks it (pre-bucketed pages beat any
+        # span scan); any decline or failure falls through to the
+        # interpreter paths below, bit-identically.
+        if sp_rule is None and all(m.version == "vtpu1" for m in metas):
+            from tempo_tpu import compiled
+            wire = compiled.try_query_range(self.db, tenant, plan, metas)
+            if wire is not None:
+                return wire
         if sp_rule is None and evaluator is not None and len(metas) > 1 and all(
             m.version == "vtpu1" for m in metas
         ):
@@ -301,7 +313,11 @@ class Querier:
                 on_block_error=self.db.block_failure_recorder(tenant),
                 on_block_ok=self.db.block_success_recorder(tenant),
             )
-            return acc.to_wire()
+            wire = acc.to_wire()
+            # the compiled tier declined (or is off): the job ran on an
+            # interpreter path — insights aggregate this per query
+            wire["compiledShape"] = "fallback"
+            return wire
         acc = make_accumulator(plan)
         for m in metas:
             # per-block sub-accumulator (shared series table), merged
@@ -332,7 +348,49 @@ class Querier:
             for key, ex in sub.exemplars.items():
                 have = acc.exemplars.setdefault(key, [])
                 have.extend(ex[: max(0, plan.exemplars - len(have))])
-        return acc.to_wire()
+        wire = acc.to_wire()
+        wire["compiledShape"] = "fallback"
+        return wire
+
+    def query_range_blocks_multi(self, tenant: str, block_ids: list,
+                                 queries: list, start_s: int, end_s: int,
+                                 step_s: int, max_series: int = 64,
+                                 exemplars: int = 0) -> list:
+        """N concurrent query_range requests against ONE block batch
+        (the metrics analog of search_block_batch_multi): lowerable
+        same-shape plans coalesce into one fused compiled launch over a
+        shared page stack; the rest fall back to per-query evaluation.
+        Results are positionally aligned and bit-identical to N
+        sequential query_range_blocks calls."""
+        from tempo_tpu.metrics_engine import compile_metrics_plan
+
+        queries = list(queries)
+        if not queries:
+            return []
+        plans = [compile_metrics_plan(q, start_s, end_s, step_s,
+                                      max_series=max_series,
+                                      exemplars=exemplars)
+                 for q in queries]
+        out = [None] * len(queries)
+        metas = []
+        for bid in block_ids:
+            try:
+                metas.append(self.db.backend.block_meta(tenant, bid))
+            except NotFound:
+                log.warning("metrics job: block %s deleted mid-query", bid)
+        if len(plans) > 1 and metas and all(m.version == "vtpu1"
+                                            for m in metas):
+            from tempo_tpu import compiled
+            wires = compiled.try_query_range_many(self.db, tenant, plans,
+                                                  metas)
+            for i, w in enumerate(wires):
+                out[i] = w
+        for i, q in enumerate(queries):
+            if out[i] is None:
+                out[i] = self.query_range_blocks(
+                    tenant, block_ids, q, start_s, end_s, step_s,
+                    max_series=max_series, exemplars=exemplars)
+        return out
 
     # ------------------------------------------------------------------
     # trace-graph analytics (service dependencies / critical paths)

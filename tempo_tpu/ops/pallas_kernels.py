@@ -411,6 +411,12 @@ def _dbp_decode_jit(words: jnp.ndarray, first_hi, first_lo, width, n: int):
     return jax.lax.associative_scan(_limb_add, (hs, ls))
 
 
+# Public seam: the compiled query tier's fused metrics program composes
+# this decode inline (vmapped over stacked units), so compiled-vs-host
+# bit identity on dbp columns reduces to this single definition.
+dbp_decode_limbs = _dbp_decode_jit
+
+
 def dbp_decode_device(page: bytes, dtype: str, shape: tuple) -> np.ndarray:
     """Decode one dbp page ON DEVICE (the host only reinterprets the
     packed bytes as u32 words — no codec work). Bit-identical to

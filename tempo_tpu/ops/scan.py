@@ -216,10 +216,13 @@ def _dbp_between_resident_jit(words, first_hi, first_lo, width, bounds,
     return ge & le
 
 
-def _pad_codes_u32(codes: np.ndarray) -> np.ndarray:
+def pad_codes_u32(codes: np.ndarray) -> np.ndarray:
     """Pow2-pad a code set by REPEATING its first code (bounds the jit
     cache without changing membership — unlike a sentinel pad, which
-    would alter verdicts for columns that contain the sentinel)."""
+    would alter verdicts for columns that contain the sentinel). Public:
+    the compiled query tier pads its per-unit code sets with the same
+    rule, so its membership verdicts inherit this path's exactness
+    argument verbatim."""
     codes = np.asarray(codes).astype(np.uint32, copy=False).reshape(-1)
     if codes.size == 0:
         codes = np.array([NO_MATCH_CODE], np.uint32)
@@ -229,6 +232,9 @@ def _pad_codes_u32(codes: np.ndarray) -> np.ndarray:
     if k == codes.size:
         return codes
     return np.concatenate([codes, np.full(k - codes.size, codes[0], np.uint32)])
+
+
+_pad_codes_u32 = pad_codes_u32  # compat alias for older call sites
 
 
 def resident_in_set_mask(res, codes: np.ndarray,

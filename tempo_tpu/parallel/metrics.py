@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from tempo_tpu.parallel.mesh import RANGE_AXIS, WINDOW_AXIS, shard_map_compat
-from tempo_tpu.parallel.search import _dispatch_lock
+from tempo_tpu.parallel.search import dispatch_lock as _dispatch_lock
 
 log = logging.getLogger(__name__)
 

@@ -39,7 +39,11 @@ from tempo_tpu.util.devicetiming import timed_dispatch
 # tests/test_race_stress.py's concurrent-search scenario on the 8-way
 # CPU mesh). Device execution is serial per device anyway, so holding
 # one lock across dispatch + result materialization costs nothing.
-_dispatch_lock = threading.Lock()
+# Public name: every device-program dispatcher in the process (mesh
+# search/metrics here, the compiled query tier) serializes on this ONE
+# lock — two lock objects would reintroduce the deadlock pairwise.
+dispatch_lock = threading.Lock()
+_dispatch_lock = dispatch_lock  # compat alias for in-tree callers
 
 # fused-batch width observability: mean width over a window =
 # rate(lanes) / rate(tempo_tpu_device_dispatches_total{kernel="batched_rle_scan"})

@@ -27,7 +27,6 @@ import contextlib
 import contextvars
 import json
 import logging
-import re
 import threading
 import time
 from collections import deque
@@ -43,28 +42,16 @@ insights_total = metrics.counter(
     "reason (error | partial | slow | sampled)",
 )
 
-# literals in TraceQL / tag expressions -> "?" so records group by shape
-_STR_RE = re.compile(r'"(?:[^"\\]|\\.)*"|`[^`]*`')
-_NUM_RE = re.compile(r"\b\d+(?:\.\d+)?(?:ns|us|ms|s|m|h)?\b")
-
-
-def normalize_query(q: str) -> str:
-    """Strip literal values from a TraceQL query, keep its shape."""
-    q = _STR_RE.sub('"?"', q)
-    q = _NUM_RE.sub("?", q)
-    return " ".join(q.split())
-
-
-def normalize_search(req) -> str:
-    """Normalized form of a tag-search request: TraceQL shape when a
-    query rides it, else the sorted tag-key skeleton."""
-    if getattr(req, "query", ""):
-        return normalize_query(req.query)
-    keys = ",".join(sorted(getattr(req, "tags", {}) or {}))
-    parts = [f"tags:{keys or '<none>'}"]
-    if getattr(req, "min_duration_ns", 0) or getattr(req, "max_duration_ns", 0):
-        parts.append("duration:?")
-    return " ".join(parts)
+# The literal-stripping normalizer lives in util/queryshape so the
+# compiled-tier cache key and these records agree by construction;
+# re-exported here because callers and tests address it as
+# insights.normalize_query / insights.normalize_search.
+from tempo_tpu.util.queryshape import (  # noqa: F401  (re-export)
+    _NUM_RE,
+    _STR_RE,
+    normalize_query,
+    normalize_search,
+)
 
 
 _active: contextvars.ContextVar = contextvars.ContextVar(

@@ -200,6 +200,13 @@ FAMILY_SERIES_BUDGETS = {
     # dropped at deregistration)
     "tempo_tpu_standing_queries": 64,
     "tempo_tpu_standing_alert_firing": 64,
+    # compiled-query tier: label-less cache totals — shapes/programs
+    # must NEVER become labels here; per-shape data belongs on
+    # /api/query-insights
+    "tempo_tpu_compiled_hits_total": 2,
+    "tempo_tpu_compiled_misses_total": 2,
+    "tempo_tpu_compiled_compiles_total": 2,
+    "tempo_tpu_compiled_evictions_total": 2,
     # trace-graph analytics plane: label-less totals + a small kind enum
     # (dependencies | critical_path | walks) — edges/services must NEVER
     # become labels here; per-edge data belongs in query responses

@@ -1,0 +1,66 @@
+"""util/queryshape: the shared literal-stripping shape normalizer.
+
+The compiled-query tier keys its executable cache by these shapes and
+the insights log groups records by them — this suite pins (a) the
+normalizer behavior against the same fixtures tests/test_insights.py
+uses and (b) that insights re-exports THIS definition, so the two key
+spaces cannot drift apart.
+"""
+
+from tempo_tpu.encoding.common import SearchRequest
+from tempo_tpu.util import insights, queryshape
+
+
+class TestNormalizeQuery:
+    def test_strips_string_and_duration_literals(self):
+        q = '{ resource.service.name = "cart" && duration > 250ms } | rate()'
+        assert queryshape.normalize_query(q) == (
+            '{ resource.service.name = "?" && duration > ? } | rate()'
+        )
+
+    def test_literal_swap_maps_to_same_shape(self):
+        a = '{ resource.service.name = "cart" } | rate()'
+        b = '{ resource.service.name = "checkout" } | rate()'
+        assert queryshape.normalize_query(a) == queryshape.normalize_query(b)
+
+    def test_backtick_regex_literals_stripped(self):
+        q = '{ name =~ `GET /api/.*` } | count_over_time()'
+        assert "`" not in queryshape.normalize_query(q)
+        assert queryshape.normalize_query(q).startswith('{ name =~ "?" }')
+
+    def test_whitespace_collapsed(self):
+        assert queryshape.normalize_query("{  name  =  \"x\" }") == (
+            '{ name = "?" }'
+        )
+
+
+class TestNormalizeSearch:
+    def test_tag_key_skeleton_sorted(self):
+        req = SearchRequest(tags={"service": "cart", "region": "eu"},
+                            min_duration_ns=5)
+        assert queryshape.normalize_search(req) == (
+            "tags:region,service duration:?"
+        )
+
+    def test_empty_request(self):
+        assert queryshape.normalize_search(SearchRequest()) == "tags:<none>"
+
+    def test_traceql_rides_query_normalizer(self):
+        req = SearchRequest(query='{ name = "GET /x" }')
+        assert queryshape.normalize_search(req) == '{ name = "?" }'
+
+
+class TestSharedDefinition:
+    def test_insights_reexports_queryshape(self):
+        # agreement by construction, not by parallel implementation
+        assert insights.normalize_query is queryshape.normalize_query
+        assert insights.normalize_search is queryshape.normalize_search
+
+    def test_shape_keys_are_kind_tagged(self):
+        q = '{ name = "x" } | rate()'
+        assert queryshape.metrics_shape(q).startswith("query_range|")
+        req = SearchRequest(query=q)
+        assert queryshape.search_shape(req).startswith("search|")
+        # a search carrying a TraceQL query and a query_range of the
+        # same text must NOT collide in one cache key space
+        assert queryshape.metrics_shape(q) != queryshape.search_shape(req)

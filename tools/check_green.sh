@@ -22,28 +22,31 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
 rc=${PIPESTATUS[0]}
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
 
-# Hot-tier smoke (ISSUE 16): tiny repeat-query loadtest arm asserting
-# the device-resident tier serves repeats without re-shipping pages
-# (h2d flat, resident hits climbing, transfer-stage << kernel-stage).
+# Hot-tier + compiled-tier smoke (ISSUES 16/17): tiny loadtest with a
+# repeat-query arm (device-resident tier serves repeats without
+# re-shipping pages: h2d flat, resident hits climbing, transfer-stage
+# << kernel-stage) and a literal-rotation arm (the compiled tier's
+# shape cache re-enters the traced executable across literal/window
+# swaps: zero retraces, shape hits climbing, fused path dispatching).
 # Generous rss limit: a 6s run is all startup transient.
 hot_rc=0
 if [ "$rc" -eq 0 ]; then
   timeout -k 10 420 python tools/loadtest.py --duration 6 --rate 1 \
-    --skip-sweep --slo-scale 8 --rss-growth-limit 3.0 --hot 6 \
+    --skip-sweep --slo-scale 8 --rss-growth-limit 3.0 --hot 6 --shapes 4 \
     >/tmp/_t1_hot.json 2>/tmp/_t1_hot.log
   hot_rc=$?
   if [ "$hot_rc" -ne 0 ]; then
-    echo "check_green: hot-tier smoke RED (exit $hot_rc)" >&2
+    echo "check_green: hot/compiled-tier smoke RED (exit $hot_rc)" >&2
     tail -5 /tmp/_t1_hot.log >&2
   else
-    echo "check_green: hot-tier smoke green" >&2
+    echo "check_green: hot/compiled-tier smoke green" >&2
   fi
 fi
 
 if [ "$rc" -ne 0 ]; then
   echo "check_green: RED (pytest exit $rc)" >&2
 elif [ "$hot_rc" -ne 0 ]; then
-  echo "check_green: RED (hot-tier smoke exit $hot_rc)" >&2
+  echo "check_green: RED (hot/compiled-tier smoke exit $hot_rc)" >&2
   rc=$hot_rc
 else
   echo "check_green: green" >&2

@@ -1087,6 +1087,100 @@ def hot_tier_probe(query_url: str, scrape_urls: list, iters: int = 8,
     }
 
 
+# ---------------------------------------------------------------------------
+# --shapes arm: literal-rotation query_range workload against the
+# compiled-query tier (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+
+def _scrape_compiled(urls: list) -> dict:
+    """Sum the compiled-tier gate's counters across processes."""
+    out = {"hits": 0.0, "misses": 0.0, "compiles": 0.0, "dispatches": 0.0}
+    for _name, url in urls:
+        try:
+            with urllib.request.urlopen(url + "/metrics", timeout=15) as r:
+                met = r.read().decode()
+        except Exception:  # noqa: BLE001 — a dead proc fails the gates anyway
+            continue
+        for line in met.splitlines():
+            try:
+                val = float(line.rsplit(" ", 1)[1])
+            except (ValueError, IndexError):
+                continue
+            if line.startswith("tempo_tpu_compiled_hits_total"):
+                out["hits"] += val
+            elif line.startswith("tempo_tpu_compiled_misses_total"):
+                out["misses"] += val
+            elif line.startswith("tempo_tpu_compiled_compiles_total"):
+                out["compiles"] += val
+            elif (line.startswith("tempo_tpu_device_dispatches_total")
+                    and 'kernel="compiled_metrics"' in line):
+                out["dispatches"] += val
+    return out
+
+
+def compiled_shapes_probe(query_url: str, scrape_urls: list,
+                          shapes: int = 4) -> dict:
+    """Literal-rotation arm: fire /api/metrics/query_range with ONE
+    normalized query shape whose literal and window rotate per request
+    (a dashboard refresh, distilled). The warm pass lets every querier
+    lower the shape and trace the program once; the measured pass
+    repeats the same rotation and gates on:
+
+    - ZERO new program traces (`tempo_tpu_compiled_compiles_total`
+      flat): literal and window swaps re-enter the cached executable,
+    - shape-cache hits climbing while misses stay flat (the shape key
+      ignores literals, so the rotation is one shape, not N),
+    - the fused path actually dispatching (`kernel="compiled_metrics"`
+      climbing — all-fallback would pass the other gates vacuously),
+    - every response a well-formed matrix.
+    """
+    from tempo_tpu.model import synth
+
+    base_s = 1_700_000_000  # synth traces are pinned at a fixed epoch
+    lits = [synth.SERVICES[i % len(synth.SERVICES)] for i in range(shapes)]
+
+    def fire(i: int, lit: str) -> bool:
+        qs = urllib.parse.urlencode({
+            "q": "{ resource.service.name = `%s` } | rate()" % lit,
+            "start": base_s - 300 + i, "end": base_s + 300 + i, "step": 10,
+        })
+        try:
+            with urllib.request.urlopen(
+                f"{query_url}/api/metrics/query_range?{qs}", timeout=30
+            ) as r:
+                doc = json.loads(r.read())
+            return bool(r.status == 200 and doc.get("status") == "success"
+                        and doc["data"]["resultType"] == "matrix")
+        except Exception:  # noqa: BLE001 — counted against the ok gate
+            return False
+
+    for i, lit in enumerate(lits):  # warm: lower + trace everywhere
+        fire(i, lit)
+    mid = _scrape_compiled(scrape_urls)
+    ok = sum(fire(shapes + i, lit) for i, lit in enumerate(lits))
+    after = _scrape_compiled(scrape_urls)
+
+    hot = {k: after[k] - mid[k] for k in after}
+    zero_retrace = hot["compiles"] == 0
+    hits_climb = hot["hits"] > 0
+    misses_flat = hot["misses"] == 0
+    fused_ran = hot["dispatches"] > 0
+    return {
+        "shapes_rotation": shapes,
+        "ok": ok,
+        "hot": hot,
+        "gates": {
+            "zero_retrace": zero_retrace,
+            "shape_hits_climb": hits_climb,
+            "misses_flat": misses_flat,
+            "fused_dispatches": fused_ran,
+        },
+        "passed": bool(ok == shapes and zero_retrace and hits_climb
+                       and misses_flat and fused_ran),
+    }
+
+
 def storage_summary(query_url: str) -> dict:
     """Fleet storage health from the frontend's /status/storage — the
     same compression/debt/zone-map numbers bench_suite emits, so CI
@@ -1263,6 +1357,12 @@ def main() -> int:
                          "are admitted, then N hot repeats gated on "
                          "resident hits climbing, h2d transfer bytes flat, "
                          "and transfer-stage time < half of kernel time")
+    ap.add_argument("--shapes", type=int, default=0, metavar="N",
+                    help="run a compiled-tier arm after the drain: ONE "
+                         "query_range shape with N rotating literals/"
+                         "windows, gated on zero program retraces across "
+                         "the rotation, shape-cache hits climbing, and "
+                         "the fused path actually dispatching")
     ap.add_argument("--tenants", type=int, default=1,
                     help=">1 enables multi-tenant mode: the cluster boots "
                          "with multitenancy, every op carries one of N org "
@@ -1382,6 +1482,13 @@ def main() -> int:
             hot_ok = summary["hot_tier"]["passed"]
             print(f"[loadtest] hot-tier gate: {summary['hot_tier']}",
                   file=sys.stderr)
+        shapes_ok = True
+        if args.shapes > 0:
+            summary["compiled_shapes"] = compiled_shapes_probe(
+                query_url, check_urls, shapes=args.shapes)
+            shapes_ok = summary["compiled_shapes"]["passed"]
+            print(f"[loadtest] compiled-shapes gate: "
+                  f"{summary['compiled_shapes']}", file=sys.stderr)
         summary["passed"] = bool(
             summary["slo_pass"]
             and loss["passed"]
@@ -1391,6 +1498,7 @@ def main() -> int:
             and standing_ok
             and device_ok
             and hot_ok
+            and shapes_ok
             and (rss is None or summary["rss"]["passed"])
         )
         print(json.dumps(summary))
