@@ -858,6 +858,128 @@ def _hot_tier_rep(reps: int = 3) -> dict:
         tmp.cleanup()
 
 
+def _ingest_rep(reps: int = 3) -> dict:
+    """Device-native ingest plane rep (BENCH_r07, ISSUE 18): the write
+    path's two new legs, each measured paired.
+
+    decode — the same OTLP protobuf body through the object codec
+    (Trace objects, then traces_to_batch) vs the columnar single pass
+    (straight to SpanBatch): spans/s per arm + the paired per-rep ratio.
+
+    encode — the same sorted cut through serialize_row_group with the
+    host page encoders vs the device encode arm
+    (TEMPO_TPU_DEVICE_ENCODE=0/1). The two arms' payload bytes must be
+    BYTE-IDENTICAL — a hard assert, not a warning: a divergent page
+    poisons every future reader, which is strictly worse than a failed
+    bench. The device arm's stage waterfall rides the JSON so encode
+    shows up as transfer+kernel instead of host `other`. Pages encode
+    serially here (codec.set_threads(1)) — paired arms stay comparable
+    and the waterfall attributes to one thread's clock.
+
+    Read host_vs_device against the platform (same caveat as the
+    compiled rep): on CPU both arms run the same XLA backend and the
+    device arm adds dispatch overhead, so the ratio hovers near or
+    below 1 — the byte-identity gate and the waterfall split are the
+    acceptance signal there; on an accelerator the batched kernels
+    replace the per-column host loops the ratio measures."""
+    from tempo_tpu import receivers
+    from tempo_tpu.encoding.vtpu import codec as codec_mod
+    from tempo_tpu.encoding.vtpu import format as vfmt
+    from tempo_tpu.model import synth
+    from tempo_tpu.model import trace as tr
+    from tempo_tpu.util import stagetimings
+
+    traces = synth.make_traces(3000, seed=800, spans_per_trace=8)
+    body = receivers.otlp.encode_traces_request(traces)
+    n_spans = sum(t.span_count() for t in traces)
+
+    # -- decode arms (interleaved; object arm includes traces_to_batch:
+    # both arms end at the same artifact, a columnar SpanBatch) --
+    receivers.decode_http_columnar("/v1/traces", "application/x-protobuf",
+                                   body)  # warm
+    obj_t: list = []
+    col_t: list = []
+    batch = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        ts = receivers.decode_http("/v1/traces", "application/x-protobuf",
+                                   body)
+        b_obj = tr.traces_to_batch(ts)
+        obj_t.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        batch = receivers.decode_http_columnar(
+            "/v1/traces", "application/x-protobuf", body)
+        col_t.append(time.perf_counter() - t0)
+        assert batch.num_spans == b_obj.num_spans == n_spans
+    decode = {
+        "spans": n_spans,
+        "object_spans_per_s": int(n_spans / float(np.median(obj_t))),
+        "columnar_spans_per_s": int(n_spans / float(np.median(col_t))),
+        "columnar_vs_object": round(float(np.median(
+            [o / c for o, c in zip(obj_t, col_t)])), 3),
+    }
+
+    # -- encode arms (paired over the same row groups) --
+    batch = batch.sorted_by_trace()
+    n = batch.num_spans
+    slices = [(lo, min(lo + 4096, n)) for lo in range(0, n, 4096)]
+
+    def encode_pass(device: bool, waterfall: dict | None = None):
+        os.environ["TEMPO_TPU_DEVICE_ENCODE"] = "1" if device else "0"
+        try:
+            payloads = []
+            t0 = time.perf_counter()
+            with stagetimings.request() as st:
+                for lo, hi in slices:
+                    payload, _ = vfmt.serialize_row_group(
+                        batch, lo, hi, 0, "auto")
+                    payloads.append(bytes(payload))
+                st.add("other", max(0.0, time.perf_counter() - t0
+                                    - st.total()))
+            dt = time.perf_counter() - t0
+            if waterfall is not None:
+                waterfall.clear()
+                waterfall.update(st.to_wire())
+            return dt, payloads
+        finally:
+            os.environ.pop("TEMPO_TPU_DEVICE_ENCODE", None)
+
+    codec_mod.set_threads(1)
+    try:
+        encode_pass(True)  # warm: jit compiles out of the clock
+        host_t: list = []
+        dev_t: list = []
+        wf: dict = {"host": {}, "device": {}}
+        tx: dict = {"host": [], "device": []}
+        total_bytes = 0
+        for _ in range(reps):
+            before = _transfer_totals()
+            dt, p_host = encode_pass(False, wf["host"])
+            host_t.append(dt)
+            tx["host"].append(_transfer_delta(before))
+            before = _transfer_totals()
+            dt, p_dev = encode_pass(True, wf["device"])
+            dev_t.append(dt)
+            tx["device"].append(_transfer_delta(before))
+            assert p_host == p_dev, \
+                "ingest rep: host and device encode arms diverged"
+            total_bytes = sum(len(p) for p in p_host)
+        encode = {
+            "row_groups": len(slices),
+            "payload_mb": round(total_bytes / 2**20, 2),
+            "host_s": [round(t, 4) for t in host_t],
+            "device_s": [round(t, 4) for t in dev_t],
+            "host_vs_device": round(float(np.median(
+                [h / d for h, d in zip(host_t, dev_t)])), 3),
+            "parity": "byte-identical",  # asserted above, every rep
+            "waterfall": wf,  # last rep's stage split per arm
+            "transfer": tx,
+        }
+    finally:
+        codec_mod.set_threads(0)
+    return {"decode": decode, "encode": encode}
+
+
 def _compiled_rep(reps: int = 3) -> dict:
     """Compiled-query tier rep (BENCH_r07, ISSUE 17): repeated
     query_range over the same stored blocks, `interpreted` arm
@@ -1266,6 +1388,16 @@ def main():
         print(json.dumps({"compiled": rep}))
         return
 
+    if "ingest" in sys.argv[1:]:
+        # standalone ingest-plane rep (BENCH_r07 fields): columnar
+        # decode vs the object codec + host vs device page encode with
+        # the byte-identity gate — for CI and hand-runs
+        _setup_jax()
+        rep = _ingest_rep()
+        print(f"[bench] ingest: {rep}", file=sys.stderr)
+        print(json.dumps({"ingest": rep}))
+        return
+
     # faults-off guard: perf numbers must measure the real path. A chaos
     # plan left armed in the environment would silently skew (or crash)
     # every rep, so refuse to run rather than emit a poisoned artifact.
@@ -1432,6 +1564,13 @@ def _run(dog, partial: dict):
     partial["compiled"] = compiled_rep
     print(f"[bench] compiled: {compiled_rep}", file=sys.stderr)
 
+    # device-native ingest plane: columnar decode + device page encode,
+    # paired arms with a byte-identity gate (ISSUE 18 tentpole /
+    # BENCH_r07 fields)
+    ingest_rep = _ingest_rep()
+    partial["ingest"] = ingest_rep
+    print(f"[bench] ingest: {ingest_rep}", file=sys.stderr)
+
     med, spread = _stats(tpu_times)
     blocks_per_s = B_BLOCKS / med
     # paired per-rep ratios: epoch noise hits both arms of a pair, so the
@@ -1480,6 +1619,7 @@ def _run(dog, partial: dict):
         "standing": standing_rep,
         "hot_tier": hot_tier_rep,
         "compiled": compiled_rep,
+        "ingest": ingest_rep,
     }))
 
 

@@ -306,19 +306,31 @@ class _Handler(BaseHTTPRequestHandler):
             receivers.JAEGER_THRIFT_PATH,
         ):
             ct = self.headers.get("Content-Type", "")
+            body = self._body()
+            # columnar fast path: OTLP decodes straight into a SpanBatch
+            # and skips the object-trace detour entirely. Gated off when
+            # a forwarder tee needs object traces; non-OTLP protocols
+            # return None and take the object path below.
+            batch = None
             try:
-                traces = receivers.decode_http(path, ct, self._body())
+                if getattr(app, "can_push_spans", None) and app.can_push_spans():
+                    batch = receivers.decode_http_columnar(path, ct, body)
+                if batch is None:
+                    traces = receivers.decode_http(path, ct, body)
             except (ValueError, OSError, TypeError, AttributeError, KeyError) as e:
                 # wire/thrift/json decode errors and shape-invalid JSON
                 raise BadRequest(f"malformed payload: {e}") from e
-            if traces:
-                try:
+            try:
+                if batch is not None:
+                    if batch.num_spans:
+                        app.push_spans(batch, org_id=self._org_id())
+                elif traces:
                     app.push_traces(traces, org_id=self._org_id())
-                except ValueError as e:
-                    # distributor admission contract: ValueError = the
-                    # request can never be admitted (e.g. one batch over
-                    # the whole inflight budget) — client error, not 500
-                    raise BadRequest(str(e)) from e
+            except ValueError as e:
+                # distributor admission contract: ValueError = the
+                # request can never be admitted (e.g. one batch over
+                # the whole inflight budget) — client error, not 500
+                raise BadRequest(str(e)) from e
             if path == receivers.OTLP_HTTP_PATH:
                 # OTLP/HTTP: response content type must match the request;
                 # empty ExportTraceServiceResponse = empty proto message

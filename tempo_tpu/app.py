@@ -570,6 +570,20 @@ class App:
             self.resolve_tenant(org_id), traces
         )
 
+    def can_push_spans(self) -> bool:
+        """True when the columnar ingest fast path may be used: a
+        forwarder tee needs object-form traces, so its presence forces
+        the object path."""
+        return (self.distributor is not None
+                and self.distributor.forwarder_manager is None)
+
+    def push_spans(self, batch, org_id=None):
+        """Columnar ingest entry: a receiver-decoded SpanBatch straight
+        into the distributor fan-out, no object traces in between."""
+        self._require(self.distributor, "ingest").push_batch(
+            self.resolve_tenant(org_id), batch
+        )
+
     def find_trace(self, trace_id: bytes, org_id=None):
         return self._require(self.frontend, "queries").find_trace_by_id(
             self.resolve_tenant(org_id), trace_id

@@ -401,6 +401,40 @@ def check_config(cfg: Config) -> list[str]:
                 "hierarchy — every device admission rebuilds its payload "
                 "through a host tier too small to hold it"
             )
+    # -- device-native ingest plane ---------------------------------------
+    if os.environ.get("TEMPO_TPU_DEVICE_ENCODE", "").lower() in (
+            "1", "true", "yes", "force") and app.device_tier.budget_mb <= 0:
+        warnings.append(
+            "TEMPO_TPU_DEVICE_ENCODE is forced on while device_tier.budget_mb "
+            "is 0: flush pages encode on device but the just-cut tail cannot "
+            "stay resident, so every standing fold and live-tail search "
+            "re-ships the columns the encoder just had in HBM — give the "
+            "tier a budget (with an ingest_tail share) or drop the override"
+        )
+    tail_mb = app.device_tier.ingest_tail_budget_mb
+    if tail_mb > 0:
+        if tail_mb > app.device_tier.budget_mb:
+            warnings.append(
+                f"device_tier.ingest_tail_budget_mb ({tail_mb}) exceeds "
+                f"device_tier.budget_mb ({app.device_tier.budget_mb}): the "
+                "tail share is carved OUT of the tier budget, never added "
+                "to it — an inverted hierarchy that evicts every hot page "
+                "to park tails which then shed first anyway"
+            )
+        # parked tail per cut ~ 44 bytes/span of the cut batch; an
+        # immediate (pressure) cut can cut the whole live-trace pool at
+        # once, so a tail budget under ~1/8 of that pool churns: each
+        # cut evicts the previous cut before any query sees it resident
+        live_bytes = app.resource.live_trace_bytes
+        if 0 < live_bytes and (tail_mb << 20) < live_bytes // 8:
+            warnings.append(
+                f"device_tier.ingest_tail_budget_mb ({tail_mb}) cannot hold "
+                "one maximum cut (resource.live_trace_bytes "
+                f"{live_bytes >> 20} MB cut at once parks ~"
+                f"{live_bytes >> 23} MB of columns): tails evict each other "
+                "before standing folds or live-tail search hit them — size "
+                "the share to at least live_trace_bytes/8"
+            )
     # -- compiled-query tier ----------------------------------------------
     if app.compiled.enabled and app.multitenancy_enabled \
             and app.compiled.max_shapes <= 0:

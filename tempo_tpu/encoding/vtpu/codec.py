@@ -19,6 +19,7 @@ tempodb/encoding/v2/page.go).
 
 from __future__ import annotations
 
+import contextvars
 import os
 import threading
 import zlib
@@ -84,11 +85,15 @@ def pool() -> ThreadPoolExecutor | None:
 
 def map_pages(fn, items: list):
     """Run fn over items on the codec pool (ordered results); serial when
-    the pool is disabled or for trivial batches."""
+    the pool is disabled or for trivial batches. The caller's context
+    (stage-timing accumulator, deadline scope) propagates into the pool
+    threads — same idiom as db/pool.JobPool — so a flush's device encode
+    dispatches land in its waterfall instead of vanishing."""
     p = pool()
     if p is None or len(items) <= 1:
         return [fn(it) for it in items]
-    return list(p.map(fn, items))
+    ctx = contextvars.copy_context()
+    return list(p.map(lambda it: ctx.copy().run(fn, it), items))
 
 
 def best_codec() -> str:
@@ -119,6 +124,15 @@ def encode(arr: np.ndarray, codec: str) -> tuple[bytes, int]:
         from tempo_tpu.encoding.vtpu import lightweight
 
         raw_crc = zlib.crc32(np.ascontiguousarray(arr).tobytes())
+        # device-encode arm (ops/encode): bit-identical pages from the
+        # batched kernels when armed; None means "use the host encoder"
+        # (kill switch, tiny page, or a counted per-column fallback)
+        from tempo_tpu.ops import encode as device_encode
+
+        if device_encode.device_encode_enabled():
+            page = device_encode.encode_page_device(arr, codec)
+            if page is not None:
+                return page, raw_crc
         enc = {"rle": lightweight.rle_encode, "dbp": lightweight.dbp_encode,
                "dct": lightweight.dct_encode}[codec]
         return enc(arr), raw_crc

@@ -163,7 +163,10 @@ def _register_metrics(cache) -> None:
             f"Column cache {name} by tier (host=decoded arrays, "
             "device=resident compressed pages; colcache.stats)",
         )
-        for name in ("hits", "misses", "evictions", "bytes", "entries")
+        # the tail_* trio only ever appears on the device tier (the
+        # ingest_tail keyspace); host stats simply never set them
+        for name in ("hits", "misses", "evictions", "bytes", "entries",
+                     "tail_bytes", "tail_entries", "tail_max_bytes")
     }
 
     def collect():
@@ -181,6 +184,15 @@ def _register_metrics(cache) -> None:
 # device-resident hot tier
 # ---------------------------------------------------------------------------
 
+# key-space tag for just-cut ingest tails parked by the cut path; these
+# entries bypass page-heat admission, live under their own sub-budget,
+# and are shed before any hot page
+TAIL_KEYSPACE = "ingest_tail"
+
+
+def is_tail_key(key) -> bool:
+    return isinstance(key, tuple) and len(key) > 0 and key[0] == TAIL_KEYSPACE
+
 
 @dataclasses.dataclass
 class DeviceTierConfig:
@@ -190,6 +202,13 @@ class DeviceTierConfig:
     they will not re-scan."""
 
     budget_mb: int = 0
+    # sub-budget (carved out of budget_mb, never additive) for the
+    # just-cut ingest tail: the cut path parks its columnar tail here so
+    # standing folds and live-tail search evaluate where the data
+    # already sits. 0 disables parking. Tail entries are shed FIRST
+    # under pressure — they re-materialize from the WAL for free at the
+    # next cut, unlike hot pages which cost a re-ship.
+    ingest_tail_budget_mb: int = 0
     # a page must have re-shipped at least this often before it can be
     # admitted (the first ship is unavoidable; one re-ship may be noise)
     admit_min_ships: int = 2
@@ -237,8 +256,11 @@ class DeviceTier:
 
     def __init__(self, budget_bytes: int, governor=None,
                  admit_min_ships: int = 2, refresh_s: float = 30.0,
-                 respect_governor: bool = True, max_query_batch: int = 8):
+                 respect_governor: bool = True, max_query_batch: int = 8,
+                 ingest_tail_budget_bytes: int = 0):
         self.budget_bytes = int(budget_bytes)
+        self.ingest_tail_budget_bytes = int(ingest_tail_budget_bytes)
+        self._tail_bytes = 0
         self._governor = governor  # None = process governor, bound lazily
         self.admit_min_ships = int(admit_min_ships)
         self.refresh_s = float(refresh_s)
@@ -274,8 +296,10 @@ class DeviceTier:
                    * self._PRESSURE_FACTORS.get(self._level(), 1.0))
 
     def shed(self) -> int:
-        """Evict LRU-first down to the pressure-scaled budget. Called on
-        every get/offer (cheap when under budget) and by the governor's
+        """Evict down to the pressure-scaled budget: ingest-tail entries
+        FIRST (oldest first — they re-materialize from the WAL at the
+        next cut for free), then LRU over the hot pages. Called on every
+        get/offer (cheap when under budget) and by the governor's
         metrics collector, so a pressure spike empties the tier even if
         no query arrives to trigger it. Dropping the reference IS the
         device free — jax reclaims the buffer."""
@@ -283,7 +307,12 @@ class DeviceTier:
         n = 0
         with self._lock:
             while self._bytes > limit and self._lru:
-                _, res = self._lru.popitem(last=False)
+                key = next((k for k in self._lru if is_tail_key(k)), None)
+                if key is None:
+                    key, res = self._lru.popitem(last=False)
+                else:
+                    res = self._lru.pop(key)
+                    self._tail_bytes -= res.nbytes
                 self._bytes -= res.nbytes
                 self.evictions += 1
                 n += 1
@@ -377,6 +406,58 @@ class DeviceTier:
                 self.evictions += 1
         return True
 
+    # -- ingest tail ---------------------------------------------------
+    def effective_tail_budget_bytes(self) -> int:
+        """Pressure-scaled tail sub-budget, never above the tier's own
+        effective budget (the tail is carved out of it, not added)."""
+        limit = self.ingest_tail_budget_bytes
+        if self.respect_governor:
+            limit = int(limit * self._PRESSURE_FACTORS.get(self._level(), 1.0))
+        return min(limit, self.effective_budget_bytes())
+
+    def park_tail(self, key, arrays: dict, meta: dict | None = None,
+                  host_bytes: int = 0) -> bool:
+        """Park a just-cut columnar tail under the `ingest_tail` key
+        space. Unlike offer(), this bypasses the page-heat admission set
+        — a cut is hot by construction (the standing fold and live-tail
+        search hit it immediately, before any ledger heat could accrue)
+        — but pays its own sub-budget, and tail entries are the FIRST
+        thing shed under pressure. Returns True when resident."""
+        limit = self.effective_tail_budget_bytes()
+        if limit <= 0:
+            return False
+        nbytes = sum(int(a.nbytes) for a in arrays.values())
+        if nbytes <= 0 or nbytes > limit:
+            return False
+        import jax.numpy as jnp
+
+        from tempo_tpu.util import devicetiming
+
+        dev = {name: jnp.asarray(a) for name, a in arrays.items()}
+        # parking is a real h2d ship, measured where it happens — the
+        # zero-h2d claim for resident folds holds because THIS ship is
+        # the only one, amortized over every fold/scan on the cut
+        devicetiming.count_transfer("ingest_tail_park", h2d=nbytes)
+        res = _Resident("tail", dev, meta or {}, host_bytes or nbytes)
+        with self._lock:
+            prev = self._lru.get(key)
+            if prev is not None:
+                self._bytes -= prev.nbytes
+                self._tail_bytes -= prev.nbytes
+            self._lru[key] = res
+            self._bytes += res.nbytes
+            self._tail_bytes += res.nbytes
+            self.admissions += 1
+            while self._tail_bytes > limit:
+                k = next(k for k in self._lru if is_tail_key(k))
+                ev = self._lru.pop(k)
+                self._bytes -= ev.nbytes
+                self._tail_bytes -= ev.nbytes
+                self.evictions += 1
+        self.shed()
+        with self._lock:
+            return key in self._lru
+
     def record_avoided(self, nbytes: int, kernel: str = "resident_scan") -> None:
         """One resident-tier serve elided `nbytes` of h2d: feed the
         transfer plane's avoided counter + the tier's own rollup."""
@@ -400,6 +481,9 @@ class DeviceTier:
                 "avoided_bytes": self.avoided_bytes,
                 "max_bytes": self.budget_bytes,
                 "effective_max_bytes": self.effective_budget_bytes(),
+                "tail_bytes": self._tail_bytes,
+                "tail_entries": sum(1 for k in self._lru if is_tail_key(k)),
+                "tail_max_bytes": self.ingest_tail_budget_bytes,
             }
 
     def resident_pages(self, top: int = 50) -> list:
@@ -410,7 +494,12 @@ class DeviceTier:
         for key, res in items:
             row = {"codec": res.codec, "deviceBytes": res.nbytes,
                    "hostBytes": res.host_bytes}
-            if (isinstance(key, tuple) and len(key) == 3
+            if is_tail_key(key):
+                # ("ingest_tail", tenant, seg_key): slot 2 is the WAL
+                # segment identity, not a page offset
+                row.update(keyspace=TAIL_KEYSPACE, tenant=str(key[1]),
+                           segment=str(key[2]))
+            elif (isinstance(key, tuple) and len(key) == 3
                     and isinstance(key[1], str)):
                 row.update(block=str(key[0]), column=key[1],
                            offset=int(key[2]))
@@ -468,6 +557,7 @@ def configure_device_tier(cfg: "DeviceTierConfig | None") -> DeviceTier | None:
             refresh_s=cfg.refresh_s,
             respect_governor=cfg.respect_governor,
             max_query_batch=cfg.max_query_batch,
+            ingest_tail_budget_bytes=cfg.ingest_tail_budget_mb << 20,
         )
         _arm_device_metrics()
         _shared_device = tier
@@ -484,7 +574,9 @@ def shared_device_tier() -> DeviceTier | None:
                 mb = int(os.environ.get("TEMPO_TPU_DEVICE_TIER_MB", "0"))
                 if mb <= 0:
                     return None
-                tier = DeviceTier(mb << 20)
+                tail_mb = int(os.environ.get("TEMPO_TPU_INGEST_TAIL_MB", "0"))
+                tier = DeviceTier(mb << 20,
+                                  ingest_tail_budget_bytes=tail_mb << 20)
                 _arm_device_metrics()
                 _shared_device = tier
     return _shared_device

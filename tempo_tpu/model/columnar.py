@@ -95,6 +95,20 @@ class Dictionary:
         """Code for s, or None if absent (lookup without insertion)."""
         return self._index.get(s)
 
+    def add_many(self, values: list) -> np.ndarray:
+        """Vectorized add: one code array for a whole column of strings,
+        with hash/append work per UNIQUE string instead of per row (the
+        receiver hot path encodes thousands of rows drawn from a handful
+        of distinct names/services)."""
+        if not values:
+            return np.empty(0, dtype=np.uint32)
+        arr = np.asarray(values, dtype=object)
+        uniq, inv = np.unique(arr, return_inverse=True)
+        codes = np.empty(len(uniq), dtype=np.uint32)
+        for i, s in enumerate(uniq):
+            codes[i] = self.add(s)
+        return codes[inv].astype(np.uint32, copy=False)
+
     def __len__(self) -> int:
         return len(self.entries)
 

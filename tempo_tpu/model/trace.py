@@ -13,16 +13,9 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-import numpy as np
-
-from tempo_tpu.model import columnar
 from tempo_tpu.model.columnar import (
-    ATTR_COLUMNS,
-    SCOPE_RESOURCE,
     SCOPE_SPAN,
-    SPAN_COLUMNS,
     VT_BOOL,
-    VT_FLOAT,
     VT_INT,
     VT_STR,
     Dictionary,
@@ -120,66 +113,22 @@ def combine_traces(parts: list[Trace]) -> Trace | None:
 # ---------------------------------------------------------------------------
 
 
-def _attr_value_cols(value, dictionary: Dictionary):
-    if isinstance(value, bool):
-        return VT_BOOL, 0, float(value)
-    if isinstance(value, int):
-        return VT_INT, 0, float(value)
-    if isinstance(value, float):
-        return VT_FLOAT, 0, value
-    return VT_STR, dictionary.add(str(value)), 0.0
-
-
 def traces_to_batch(traces: list[Trace], dictionary: Dictionary | None = None) -> SpanBatch:
     """Flatten object traces into a SpanBatch (resource values replicated
-    per span row, well-known attrs promoted to dedicated columns)."""
-    d = dictionary or Dictionary()
-    n = sum(t.span_count() for t in traces)
-    cols = {k: np.zeros((n, w) if w else (n,), dtype=dt) for k, (dt, w) in SPAN_COLUMNS.items()}
-    attr_rows: dict[str, list] = {k: [] for k in ATTR_COLUMNS}
+    per span row, well-known attrs promoted to dedicated columns). Field
+    extraction runs through BatchBuilder: per-span work is list appends,
+    and all dictionary hashing happens once per unique string at build."""
+    from tempo_tpu.model.batchbuild import BatchBuilder
 
-    def push_attr(row, scope, key, value):
-        vt, scode, num = _attr_value_cols(value, d)
-        attr_rows["attr_span"].append(row)
-        attr_rows["attr_scope"].append(scope)
-        attr_rows["attr_key"].append(d.add(key))
-        attr_rows["attr_vtype"].append(vt)
-        attr_rows["attr_str"].append(scode)
-        attr_rows["attr_num"].append(num)
-
-    row = 0
+    b = BatchBuilder(dictionary)
     for t in traces:
         for resource, spans in t.batches:
-            service = d.add(str(resource.get("service.name", "")))
-            res_extra = [(k, v) for k, v in resource.items() if k != "service.name"]
+            b.begin_resource(resource)
             for s in spans:
-                cols["trace_id"][row] = np.frombuffer(s.trace_id.rjust(16, b"\x00")[-16:], dtype=">u4")
-                cols["span_id"][row] = np.frombuffer(s.span_id.rjust(8, b"\x00")[-8:], dtype=">u4")
-                cols["parent_span_id"][row] = np.frombuffer(
-                    (s.parent_span_id or b"\x00" * 8).rjust(8, b"\x00")[-8:], dtype=">u4"
-                )
-                cols["start_unix_nano"][row] = s.start_unix_nano
-                cols["duration_nano"][row] = s.duration_nano
-                cols["kind"][row] = s.kind
-                cols["status_code"][row] = s.status_code
-                cols["name"][row] = d.add(s.name)
-                cols["service"][row] = service
-                for k, v in s.attributes.items():
-                    if k == "http.status_code":
-                        cols["http_status"][row] = int(v)
-                    elif k == "http.method":
-                        cols["http_method"][row] = d.add(str(v))
-                    elif k == "http.url":
-                        cols["http_url"][row] = d.add(str(v))
-                    else:
-                        push_attr(row, SCOPE_SPAN, k, v)
-                for k, v in res_extra:
-                    push_attr(row, SCOPE_RESOURCE, k, v)
-                row += 1
-    attrs = {}
-    for k, (dt, _) in ATTR_COLUMNS.items():
-        attrs[k] = np.asarray(attr_rows[k], dtype=dt)
-    return SpanBatch(cols=cols, attrs=attrs, dictionary=d)
+                b.add_span(s.trace_id, s.span_id, s.parent_span_id, s.name,
+                           s.kind, s.start_unix_nano, s.duration_nano,
+                           s.status_code, s.attributes)
+    return b.build()
 
 
 def batch_to_traces(batch: SpanBatch) -> list[Trace]:

@@ -23,7 +23,7 @@ import numpy as np
 from tempo_tpu.encoding.vtpu import format as fmt
 from tempo_tpu.model.columnar import SpanBatch
 from tempo_tpu.model.trace import Trace, batch_to_traces, combine_traces
-from tempo_tpu.util import metrics, resource, tracing, usage
+from tempo_tpu.util import metrics, resource, stagetimings, tracing, usage
 from tempo_tpu.util.flushqueues import ExclusiveQueues, FlushOp
 
 log = logging.getLogger(__name__)
@@ -218,12 +218,27 @@ class TenantInstance:
         except BaseException:
             wal_pool.sub(cut_bytes)  # append failed: nothing to account
             raise
+        # park the just-cut columns device-side under the WAL segment's
+        # identity: the standing fold below and live-tail search then
+        # evaluate where the data already sits (zero h2d per query).
+        # Best-effort — a missing/full device tier just means host paths.
+        tail_key = None
+        tier = self._device_tier()
+        if tier is not None:
+            from tempo_tpu.ops import ingest_tail
+            tail_key = ingest_tail.park_cut(tier, self.tenant, seg_key, batch)
+        batch._tail_key = tail_key
         # standing-query fold: evaluate every registered query against
         # ONLY this cut's spans — O(delta), outside the instance lock
         # (the engine serializes itself), and never fatal to the cut
         if self.standing is not None:
             self.standing.fold(self.tenant, batch, seg_key=seg_key)
         return len(cut)
+
+    def _device_tier(self):
+        from tempo_tpu.encoding.vtpu import colcache
+
+        return colcache.shared_device_tier()
 
     def cut_block_if_ready(self, now: float | None = None, immediate: bool = False):
         """Head block -> completing (reference: instance.go:275)."""
@@ -264,7 +279,15 @@ class TenantInstance:
             # (reference: CompleteBlock's span, flush.go:298)
             with tracing.span("ingester/complete_block", tenant=self.tenant,
                               block=str(blk.block_id)):
-                meta = self.db.write_wal_block(self.tenant, blk, block_id=blk.block_id)
+                # flush waterfall: device page encodes inside record
+                # kernel/transfer (util/devicetiming); the host remainder
+                # (merge-sort, host codecs, backend PUT) lands in "other"
+                with stagetimings.request() as flush_st:
+                    t0 = time.perf_counter()
+                    meta = self.db.write_wal_block(self.tenant, blk, block_id=blk.block_id)
+                    flush_st.add("other", max(
+                        0.0, time.perf_counter() - t0 - flush_st.total()))
+                    flush_st.observe("flush")
         except BaseException:
             with self.lock:
                 self._inflight.discard(blk.block_id)
@@ -379,12 +402,23 @@ class TenantInstance:
         return combine_traces(parts)
 
     def live_batches(self) -> list[SpanBatch]:
-        """All not-yet-flushed columnar data (for SearchRecent)."""
+        """All not-yet-flushed columnar data (for SearchRecent). WAL
+        segments are annotated with their device-tail key (the same
+        "<block_id>:<seg>" identity the cut path parked under) so the
+        querier's live-tail scan can find the resident copy."""
         with self.lock:
             segs = [seg for lt in self.live.values() for seg in lt.segments]
             wal_blocks = [self.head] + list(self.completing)
+        from tempo_tpu.ops import ingest_tail
         for blk in wal_blocks:
-            segs.extend(blk.iter_batches())
+            keyed = getattr(blk, "iter_batches_keyed", None)
+            if keyed is not None:
+                for i, seg in keyed():
+                    seg._tail_key = ingest_tail.tail_key(
+                        self.tenant, f"{blk.block_id}:{i}")
+                    segs.append(seg)
+            else:
+                segs.extend(blk.iter_batches())
         return segs
 
     def live_only_batches(self) -> list[SpanBatch]:
@@ -408,8 +442,11 @@ class TenantInstance:
                 # keys come from the on-disk segment numbers, so a
                 # skipped corrupt segment cannot shift later segments
                 # onto the wrong fold keys
+                from tempo_tpu.ops import ingest_tail
                 for i, batch in keyed():
-                    out.append((f"{blk.block_id}:{i}", batch))
+                    seg_key = f"{blk.block_id}:{i}"
+                    batch._tail_key = ingest_tail.tail_key(self.tenant, seg_key)
+                    out.append((seg_key, batch))
             else:  # encodings without keyed replay: enumerate order
                 for i, batch in enumerate(blk.iter_batches()):
                     out.append((f"{blk.block_id}:{i}", batch))

@@ -534,6 +534,23 @@ def _search_batch(batch: SpanBatch, req: SearchRequest) -> SearchResponse:
     if n == 0:
         return resp
     d = batch.dictionary
+    # resident-tail fast path: a just-cut WAL segment whose columns are
+    # parked on device (ops/ingest_tail) gets its span mask computed
+    # where the data sits — None means "not resident or a tag needs the
+    # attribute table", and the host loop below runs unchanged
+    device_mask = None
+    if getattr(batch, "_tail_key", None) is not None:
+        from tempo_tpu.ops import ingest_tail
+
+        try:
+            device_mask = ingest_tail.tail_search_mask(batch, req)
+        except Exception:
+            log.exception("live-tail device scan failed; using host scan")
+    if device_mask is not None:
+        mask = device_mask
+        if not mask.any():
+            return resp
+        return _segment_hits(batch, mask, req, resp)
     mask = np.ones(n, bool)
     for k, v in req.tags.items():
         v = str(v)
@@ -571,7 +588,14 @@ def _search_batch(batch: SpanBatch, req: SearchRequest) -> SearchResponse:
         mask &= batch.cols["duration_nano"] <= np.uint64(req.max_duration_ns)
     if not mask.any():
         return resp
+    return _segment_hits(batch, mask, req, resp)
 
+
+def _segment_hits(batch: SpanBatch, mask: np.ndarray, req: SearchRequest,
+                  resp: SearchResponse) -> SearchResponse:
+    """Masked spans -> per-trace search hits (shared by the host scan and
+    the resident-tail device scan)."""
+    d = batch.dictionary
     # one permutation for both the rows and the mask
     perm = batch.trace_sort_perm()
     sb = batch.select(perm)

@@ -15,4 +15,4 @@ cardinality (north star in BASELINE.json).
 # VtpuCompactor, write_block) — NOT as an import side effect here, so
 # merely importing tempo_tpu.ops never mutates global JAX config for
 # library consumers (round-4 advisor finding).
-from tempo_tpu.ops import bloom, hashing, merge, scan, sketch  # noqa: F401
+from tempo_tpu.ops import bloom, encode, hashing, merge, scan, sketch  # noqa: F401

@@ -1,0 +1,512 @@
+"""Resident just-cut tail: park cut columns on device, fold and scan
+them where they sit.
+
+The cut path (`TenantInstance.cut_complete_traces`) parks the dedicated
+columns of each freshly cut batch in the PR 16 DeviceTier under the
+`ingest_tail` key space — `("ingest_tail", tenant, "<block_id>:<seg>")`,
+the same identity the WAL gives the segment, so any consumer holding a
+WAL segment can reconstruct the key without side channels. While the
+entry is resident:
+
+- the standing fold (`standing/engine._fold_one`) lowers supported
+  plans (rate/count_over_time over dedicated-column equality/compare
+  filters, optional by() on a dedicated string column) to one device
+  bincount over the parked columns — h2d per fold is a few hundred
+  bytes of bin edges and literals, never the columns; and
+- live-tail search (`querier._search_batch`) computes its span mask on
+  device for dedicated-column tags + duration bounds.
+
+Both paths record the column bytes they did NOT ship via
+`DeviceTier.record_avoided`, so the win is ledger-verified
+(`tempo_tpu_device_transfer_bytes_avoided_total{kernel=standing_fold|
+live_tail_scan}` climbing while the same kernels' h2d stays flat).
+
+Exactness: lowering is conservative. A fold plan lowers only when every
+filter stage is a dedicated-column predicate with the EXACT dedicated
+scope (`resource.service.name`, `span.http.*`, intrinsic `name`) —
+`any`-scope attributes also probe the attribute table on the host path
+(shadowing), which the parked tail cannot see. Anything else returns
+None and the caller runs the host path, bit-identical by construction.
+Series registration replicates eval_batch's order exactly: unique by()
+codes ascending (only those with counted rows), then the nil series.
+
+64-bit device arithmetic (timestamps, durations) is two-u32-limb
+compares — x64 is disabled, so shipping u64 would silently truncate.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+
+import numpy as np
+
+from tempo_tpu.traceql.ast_nodes import (
+    Attribute,
+    Binary,
+    Intrinsic,
+    Literal,
+    SpansetFilter,
+)
+
+log = logging.getLogger(__name__)
+
+TAIL_KEYSPACE = "ingest_tail"
+
+# columns parked per cut: dictionary-code and enum columns as u32 lanes,
+# 64-bit timestamps/durations as (lo, hi) u32 limb pairs
+_CODE_COLS = ("service", "name", "http_method", "http_url")
+_PARKED = _CODE_COLS + ("http_status", "kind", "status_code",
+                        "start_lo", "start_hi", "dur_lo", "dur_hi")
+
+# (scope, attribute name) -> parked column; exact dedicated scopes ONLY
+# (mirrors traceql.vector._DEDICATED + _DEDICATED_SCOPES — `any` scope
+# would also probe the attr table, which the tail does not park)
+_STR_ATTRS = {
+    ("resource", "service.name"): "service",
+    ("span", "http.method"): "http_method",
+    ("span", "http.url"): "http_url",
+}
+_NUM_ATTRS = {("span", "http.status_code"): "http_status"}
+_CMP_OPS = ("=", "!=", ">", ">=", "<", "<=")
+
+# code columns never reach this value (dictionary codes are dense small
+# ints), so it is a safe "matches nothing" sentinel — the same one the
+# host vector path uses for absent string literals
+_ABSENT = np.uint32(0xFFFFFFFF)
+
+_MAX_FOLD_BINS = 2048
+_MAX_FOLD_SERIES = 4096
+
+
+def _pow2(n: int) -> int:
+    p = 8
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _limbs(col: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    v = np.ascontiguousarray(col).view("<u4").reshape(-1, 2)
+    return v[:, 0], v[:, 1]
+
+
+# ---------------------------------------------------------------------------
+# parking
+# ---------------------------------------------------------------------------
+
+
+def tail_key(tenant: str, seg_key: str) -> tuple:
+    return (TAIL_KEYSPACE, tenant, seg_key)
+
+
+def park_cut(tier, tenant: str, seg_key: str, batch) -> tuple | None:
+    """Park one cut batch's dedicated columns; returns the tier key when
+    resident, None when parking is off/failed. Rows are padded to a
+    power of two (repeating zeros) so the fold/scan kernels compile per
+    size bucket, not per cut."""
+    n = batch.num_spans
+    if tier is None or n == 0 or tier.effective_tail_budget_bytes() <= 0:
+        return None
+    try:
+        c = batch.cols
+        host_bytes = (sum(c[k].nbytes for k in _CODE_COLS)
+                      + c["http_status"].nbytes + c["kind"].nbytes
+                      + c["status_code"].nbytes
+                      + c["start_unix_nano"].nbytes
+                      + c["duration_nano"].nbytes)
+        p = _pow2(n)
+        arrays = {}
+        for k in _CODE_COLS:
+            arrays[k] = _pad_u32(c[k], p)
+        arrays["http_status"] = _pad_u32(c["http_status"], p)
+        arrays["kind"] = _pad_u32(c["kind"], p)
+        arrays["status_code"] = _pad_u32(c["status_code"], p)
+        s_lo, s_hi = _limbs(c["start_unix_nano"])
+        d_lo, d_hi = _limbs(c["duration_nano"])
+        arrays["start_lo"] = _pad_u32(s_lo, p)
+        arrays["start_hi"] = _pad_u32(s_hi, p)
+        arrays["dur_lo"] = _pad_u32(d_lo, p)
+        arrays["dur_hi"] = _pad_u32(d_hi, p)
+        key = tail_key(tenant, seg_key)
+        if tier.park_tail(key, arrays, meta={"n": n}, host_bytes=host_bytes):
+            return key
+    except Exception:
+        log.exception("parking ingest tail %s failed; queries use the "
+                      "host path", seg_key)
+    return None
+
+
+def _pad_u32(col: np.ndarray, p: int) -> np.ndarray:
+    out = np.zeros(p, np.uint32)
+    out[: col.shape[0]] = col.astype(np.uint32, copy=False)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# standing-fold lowering
+# ---------------------------------------------------------------------------
+
+
+class FoldPlan:
+    """A standing plan lowered onto the parked columns."""
+
+    __slots__ = ("preds", "by_col")
+
+    def __init__(self, preds: tuple, by_col: str | None):
+        self.preds = preds  # tuple of (col, op, kind)
+        self.by_col = by_col
+
+
+def _lower_expr(expr) -> list | None:
+    """Conjunctive predicate list [(col, op, kind, value)], or None."""
+    if isinstance(expr, Binary) and expr.op == "&&":
+        lhs = _lower_expr(expr.lhs)
+        rhs = _lower_expr(expr.rhs)
+        if lhs is None or rhs is None:
+            return None
+        return lhs + rhs
+    if not isinstance(expr, Binary) or expr.op not in _CMP_OPS:
+        return None
+    lhs, rhs = expr.lhs, expr.rhs
+    if not isinstance(rhs, Literal):
+        return None
+    if isinstance(lhs, Intrinsic) and lhs.name == "name":
+        col = "name"
+        if expr.op not in ("=", "!=") or rhs.kind != "string":
+            return None
+        return [(col, expr.op, "str", str(rhs.value))]
+    if not isinstance(lhs, Attribute):
+        return None
+    skey = (lhs.scope, lhs.name)
+    if skey in _STR_ATTRS:
+        if expr.op not in ("=", "!=") or rhs.kind != "string":
+            return None
+        return [(_STR_ATTRS[skey], expr.op, "str", str(rhs.value))]
+    if skey in _NUM_ATTRS:
+        if rhs.kind not in ("int", "float"):
+            return None
+        v = float(rhs.value)
+        # integer literals compare exactly as u32; fractional ones need
+        # the host's f64 semantics
+        if not v.is_integer() or not (0 <= v < 2**32):
+            return None
+        return [(_NUM_ATTRS[skey], expr.op, "num", int(v))]
+    return None
+
+
+def lower_fold_plan(plan) -> FoldPlan | None:
+    """Lower a MetricsPlan to the parked columns, or None (host path).
+
+    Supported: rate/count_over_time without histogram/exemplars, filter
+    stages that are {} or conjunctions of dedicated-column predicates,
+    by() absent or on a dedicated string column."""
+    if plan.func not in ("rate", "count_over_time"):
+        return None
+    if plan.hist is not None or plan.exemplars:
+        return None
+    if getattr(plan, "value_expr", None) is not None:
+        return None
+    if plan.n_bins <= 0 or plan.n_bins > _MAX_FOLD_BINS:
+        return None
+    preds: list = []
+    for st in plan.filters:
+        if not isinstance(st, SpansetFilter):
+            return None
+        if st.expr is None:
+            continue
+        lowered = _lower_expr(st.expr)
+        if lowered is None:
+            return None
+        preds.extend(lowered)
+    by_col = None
+    if plan.by_expr is not None:
+        be = plan.by_expr
+        if isinstance(be, Intrinsic) and be.name == "name":
+            by_col = "name"
+        elif isinstance(be, Attribute) and (be.scope, be.name) in _STR_ATTRS:
+            by_col = _STR_ATTRS[(be.scope, be.name)]
+        else:
+            return None
+    return FoldPlan(tuple(preds), by_col)
+
+
+@functools.lru_cache(maxsize=None)
+def _fold_kernel(spec: tuple, by: bool):
+    """spec: tuple of (col, op, kind) — shapes and literal VALUES stay
+    dynamic, so one compile serves every literal at a given shape."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fold(cols, n, lits, uvals, edges_lo, edges_hi, nb_real):
+        p = cols[0].shape[0] if cols else edges_lo.shape[0]
+        rows = jnp.arange(cols[0].shape[0], dtype=jnp.int32)
+        mask = rows < n
+        ci = 0
+        for j, (_, op, kind) in enumerate(spec):
+            c = cols[ci]
+            ci += 1
+            lit = lits[j]
+            if kind == "str":
+                if op == "=":
+                    m = (c == lit) & (c != 0)
+                else:  # "!=": defined & not-equal (host: ~eq & both)
+                    m = (c != lit) & (c != 0)
+            else:
+                defined = c != 0
+                if op == "=":
+                    m = (c == lit) & defined
+                elif op == "!=":
+                    m = (c != lit) & defined
+                elif op == ">":
+                    m = (c > lit) & defined
+                elif op == ">=":
+                    m = (c >= lit) & defined
+                elif op == "<":
+                    m = (c < lit) & defined
+                else:
+                    m = (c <= lit) & defined
+            mask = mask & m
+        t_lo, t_hi = cols[ci], cols[ci + 1]
+        ci += 2
+        # bin by edge count: edges[b] = start + b*step (b = 0..n_bins),
+        # padded with u64-max; sum(t >= edge) - 1 == (t - start) // step
+        # clamped into [-1, n_bins] exactly (two-limb unsigned compare)
+        ge = (t_hi[:, None] > edges_hi[None, :]) | (
+            (t_hi[:, None] == edges_hi[None, :])
+            & (t_lo[:, None] >= edges_lo[None, :]))
+        bin_idx = ge.sum(axis=1).astype(jnp.int32) - 1
+        valid = mask & (bin_idx >= 0) & (bin_idx < nb_real)
+        b_pad = edges_lo.shape[0] - 1
+        if by:
+            c = cols[ci]
+            idx = ((c[:, None] >= uvals[None, :]).sum(axis=1)
+                   .astype(jnp.int32) - 1)
+            flat = idx * b_pad + bin_idx
+        else:
+            flat = bin_idx
+        u_pad = uvals.shape[0] if by else 1
+        length = u_pad * b_pad
+        flat = jnp.where(valid, flat, length)
+        counts = jnp.bincount(flat, length=length + 1)[:length]
+        return counts.astype(jnp.int32)
+
+    return fold
+
+
+def resident_fold(plan, fold_plan: FoldPlan, batch, dictionary, series,
+                  tier=None, key=None):
+    """Fold one parked cut into sparse (series slot, relative bin) counts
+    on device. Returns {(slot, rel_bin): count} or None (caller falls
+    back to eval_batch — bit-identical semantics either way).
+
+    `batch` is the host copy of the SAME cut (the engine holds it
+    anyway); it is used only for the by() code inventory (np.unique on
+    host memory — no transfer), never shipped."""
+    from tempo_tpu.encoding.vtpu import colcache
+    from tempo_tpu.util.devicetiming import timed_dispatch
+
+    if tier is None:
+        tier = colcache.shared_device_tier()
+    if tier is None or key is None:
+        return None
+    entry = tier.get(key)
+    if entry is None:
+        return None
+    n = int(entry.meta.get("n", 0))
+    if n != batch.num_spans or n == 0:
+        return None
+    d = dictionary
+    spec = tuple((col, op, kind) for col, op, kind, _ in fold_plan.preds)
+    lits = np.zeros(max(len(spec), 1), np.uint32)
+    for j, (_, op, kind, value) in enumerate(fold_plan.preds):
+        if kind == "str":
+            code = d.get(str(value))
+            lits[j] = _ABSENT if code is None else np.uint32(code)
+        else:
+            lits[j] = np.uint32(value)
+    cols = [entry.arrays[col] for col, _, _ in spec]
+    cols.append(entry.arrays["start_lo"])
+    cols.append(entry.arrays["start_hi"])
+    by = fold_plan.by_col is not None
+    if by:
+        cols.append(entry.arrays[fold_plan.by_col])
+        uvals_real = np.unique(batch.cols[fold_plan.by_col].astype(np.uint32))
+        if len(uvals_real) > _MAX_FOLD_SERIES:
+            return None
+        uvals = np.full(_pow2(len(uvals_real)), _ABSENT, np.uint32)
+        uvals[: len(uvals_real)] = uvals_real
+    else:
+        uvals_real = np.zeros(0, np.uint32)
+        uvals = np.zeros(1, np.uint32)
+    nb = plan.n_bins
+    start_ns = plan.start_s * 10**9
+    step_ns = plan.step_s * 10**9
+    edges = start_ns + np.arange(nb + 1, dtype=np.uint64) * np.uint64(step_ns)
+    e_pad = _pow2(nb + 2)
+    edges_lo = np.full(e_pad, 0xFFFFFFFF, np.uint32)
+    edges_hi = np.full(e_pad, 0xFFFFFFFF, np.uint32)
+    edges_lo[: nb + 1] = (edges & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    edges_hi[: nb + 1] = (edges >> np.uint64(32)).astype(np.uint32)
+    counts = timed_dispatch(
+        "standing_fold", _fold_kernel(spec, by),
+        tuple(cols), np.int32(n), lits, uvals, edges_lo, edges_hi,
+        np.int32(nb),
+    )
+    b_pad = e_pad - 1
+    counts = np.asarray(counts)
+    # what the host fold would have walked: predicate + time + by columns
+    avoided = n * (4 * len(spec) + 8) + (n * 4 if by else 0)
+    tier.record_avoided(avoided, kernel="standing_fold")
+    out: dict = {}
+    if not by:
+        vec = counts[:nb]
+        if vec.sum() == 0:
+            return out
+        series.slot_of("")  # register the single unlabeled series
+        for b in np.flatnonzero(vec):
+            out[(0, int(b))] = int(vec[b])
+        return out
+    mat = counts.reshape(len(uvals), b_pad)[:, :nb]
+    # registration order must replicate eval_batch: unique codes of
+    # counted rows ascending, then the nil (code 0) series
+    nil_row = None
+    for ui, u in enumerate(uvals_real):
+        row = mat[ui]
+        if not row.any():
+            continue
+        if u == 0:
+            nil_row = row
+            continue
+        slot = series.slot_of(d[int(u)])
+        if slot < 0:
+            continue  # over the series cap: dropped, same as the host
+        for b in np.flatnonzero(row):
+            k = (int(slot), int(b))
+            out[k] = out.get(k, 0) + int(row[b])
+    if nil_row is not None:
+        slot = series.slot_of(None)
+        if slot >= 0:
+            for b in np.flatnonzero(nil_row):
+                k = (int(slot), int(b))
+                out[k] = out.get(k, 0) + int(nil_row[b])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# live-tail search mask
+# ---------------------------------------------------------------------------
+
+_TAG_COLS = {
+    "name": "name",
+    "service.name": "service",
+    "service": "service",
+    "http.method": "http_method",
+    "http.url": "http_url",
+}
+
+
+@functools.lru_cache(maxsize=None)
+def _scan_kernel(n_eq: int, status: bool, min_d: bool, max_d: bool):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def scan(cols, n, codes, status_val, min_lo, min_hi, max_lo, max_hi):
+        rows = jnp.arange(cols[0].shape[0], dtype=jnp.int32)
+        mask = rows < n
+        ci = 0
+        for j in range(n_eq):
+            mask = mask & (cols[ci] == codes[j])
+            ci += 1
+        if status:
+            mask = mask & (cols[ci] == status_val)
+            ci += 1
+        if min_d or max_d:
+            d_lo, d_hi = cols[ci], cols[ci + 1]
+            if min_d:
+                mask = mask & ((d_hi > min_hi)
+                               | ((d_hi == min_hi) & (d_lo >= min_lo)))
+            if max_d:
+                mask = mask & ((d_hi < max_hi)
+                               | ((d_hi == max_hi) & (d_lo <= max_lo)))
+        return mask
+
+    return scan
+
+
+def tail_search_mask(batch, req, tier=None) -> np.ndarray | None:
+    """Device span mask for a tag search over a parked cut. Returns the
+    (n,) bool mask, or None when the batch is not resident or a tag
+    needs the attribute table (host path). Absent dictionary codes and
+    unparsable status values yield an all-False mask — exactly the host
+    loop's early-empty behavior."""
+    from tempo_tpu.encoding.vtpu import colcache
+    from tempo_tpu.util.devicetiming import timed_dispatch
+
+    key = getattr(batch, "_tail_key", None)
+    if key is None:
+        return None
+    if tier is None:
+        tier = colcache.shared_device_tier()
+    if tier is None:
+        return None
+    entry = tier.get(key)
+    if entry is None:
+        return None
+    n = batch.num_spans
+    if int(entry.meta.get("n", 0)) != n:
+        return None
+    d = batch.dictionary
+    eq_cols: list = []
+    codes: list = []
+    status_val = 0
+    has_status = False
+    empty = np.zeros(n, bool)
+    for k, v in req.tags.items():
+        v = str(v)
+        if k == "http.status_code":
+            try:
+                status_val = int(v)
+            except ValueError:
+                return empty
+            if not (0 <= status_val < 2**32):
+                return empty
+            has_status = True
+            continue
+        col = _TAG_COLS.get(k)
+        if col is None:
+            return None  # attr-table tag: host path
+        code = d.get(v)
+        if code is None:
+            return empty
+        eq_cols.append(col)
+        codes.append(code)
+    min_d = bool(req.min_duration_ns)
+    max_d = bool(req.max_duration_ns)
+    cols = [entry.arrays[c] for c in eq_cols]
+    if has_status:
+        cols.append(entry.arrays["http_status"])
+    if min_d or max_d:
+        cols.append(entry.arrays["dur_lo"])
+        cols.append(entry.arrays["dur_hi"])
+    if not cols:
+        cols = [entry.arrays["service"]]  # row-count carrier for iota
+    codes_arr = np.asarray(codes or [0], np.uint32)
+    mn = int(req.min_duration_ns or 0)
+    mx = int(req.max_duration_ns or 0)
+    mask = timed_dispatch(
+        "live_tail_scan",
+        _scan_kernel(len(eq_cols), has_status, min_d, max_d),
+        tuple(cols), np.int32(n), codes_arr, np.uint32(status_val),
+        np.uint32(mn & 0xFFFFFFFF), np.uint32(mn >> 32),
+        np.uint32(mx & 0xFFFFFFFF), np.uint32(mx >> 32),
+    )
+    avoided = n * 4 * len(eq_cols)
+    if has_status:
+        avoided += n * 2
+    if min_d or max_d:
+        avoided += n * 8
+    tier.record_avoided(max(avoided, n), kernel="live_tail_scan")
+    return np.asarray(mask)[:n]

@@ -142,6 +142,109 @@ def decode_traces_request(buf: bytes) -> list[Trace]:
 
 
 # ---------------------------------------------------------------------------
+# decode: protobuf, columnar single pass
+# ---------------------------------------------------------------------------
+
+
+def _decode_span_into(b, buf: bytes) -> None:
+    """One wire-format Span straight into a BatchBuilder row — the
+    columnar twin of _decode_span, with no Span object in between."""
+    tid, sid, pid = b"\x00" * 16, b"\x00" * 8, b"\x00" * 8
+    name = ""
+    kind = status = 0
+    start = end = 0
+    attr_bufs: list = []
+    for field, wt, val in w.iter_fields(buf):
+        if field == 1:
+            tid = bytes(val)
+        elif field == 2:
+            sid = bytes(val)
+        elif field == 4:
+            pid = bytes(val)
+        elif field == 5:
+            name = val.decode("utf-8", "replace")
+        elif field == 6:
+            kind = int(val)
+        elif field == 7:
+            start = int(val)
+        elif field == 8:
+            end = int(val)
+        elif field == 9:
+            attr_bufs.append(val)
+        elif field == 15:
+            for f2, _, v2 in w.iter_fields(val):
+                if f2 == 3:
+                    status = int(v2)
+    b.add_span(tid, sid, pid, name, kind, start, max(0, end - start),
+               status, _decode_attrs(attr_bufs) if attr_bufs else None)
+
+
+def decode_traces_request_columnar(buf: bytes, dictionary=None):
+    """Decode ExportTraceServiceRequest/TracesData bytes directly into a
+    SpanBatch: one pass over the wire, no Span/Trace objects and no
+    per-trace regrouping (trace identity IS the trace_id column; the
+    ingester regroups by ID columns anyway). Spans land in wire order."""
+    from tempo_tpu.model.batchbuild import BatchBuilder
+
+    b = BatchBuilder(dictionary)
+    for field, wt, rs in w.iter_fields(buf):
+        if field != 1:
+            continue
+        resource_attrs: dict = {}
+        span_bufs: list = []
+        for f2, _, val in w.iter_fields(rs):
+            if f2 == 1:  # Resource
+                for f3, _, v3 in w.iter_fields(val):
+                    if f3 == 1:
+                        k, v = _decode_keyvalue(v3)
+                        if k:
+                            resource_attrs[k] = v
+            elif f2 == 2:  # ScopeSpans
+                for f3, _, v3 in w.iter_fields(val):
+                    if f3 == 2:
+                        span_bufs.append(v3)
+        if "service.name" not in resource_attrs:
+            resource_attrs["service.name"] = ""
+        b.begin_resource(resource_attrs)
+        for sb in span_bufs:
+            _decode_span_into(b, sb)
+    return b.build()
+
+
+def decode_traces_json_columnar(doc: dict, dictionary=None):
+    """OTLP/JSON TracesData directly into a SpanBatch (columnar twin of
+    decode_traces_json; spans land in document order)."""
+    from tempo_tpu.model.batchbuild import BatchBuilder
+
+    b = BatchBuilder(dictionary)
+    for rs in doc.get("resourceSpans", doc.get("resource_spans", [])) or []:
+        resource_attrs = _json_attrs((rs.get("resource") or {}).get("attributes", []))
+        if "service.name" not in resource_attrs:
+            resource_attrs["service.name"] = ""
+        b.begin_resource(resource_attrs)
+        scope_spans = rs.get("scopeSpans") or rs.get("scope_spans") or rs.get("instrumentationLibrarySpans") or []
+        for ss in scope_spans:
+            for js in ss.get("spans", []) or []:
+                kind = js.get("kind", 0)
+                if isinstance(kind, str):
+                    kind = _KIND_NAMES.get(kind, 0)
+                code = (js.get("status") or {}).get("code", 0)
+                if isinstance(code, str):
+                    code = _STATUS_NAMES.get(code, 0)
+                start = int(js.get("startTimeUnixNano", 0))
+                end = int(js.get("endTimeUnixNano", 0))
+                b.add_span(
+                    _id_from_json(js.get("traceId", ""), 16),
+                    _id_from_json(js.get("spanId", ""), 8),
+                    _id_from_json(js.get("parentSpanId", ""), 8),
+                    js.get("name", ""), int(kind), start,
+                    max(0, end - start), int(code),
+                    _json_attrs(js.get("attributes", [])),
+                )
+    return b.build()
+
+
+# ---------------------------------------------------------------------------
 # encode: protobuf
 # ---------------------------------------------------------------------------
 

@@ -458,6 +458,36 @@ class StandingEngine:
                 q.dirty = True
             return
         plan = rules_mod.window_plan(q.template, start, int(n_bins))
+        # resident-tail fast path: when this cut's columns are parked on
+        # device (ops/ingest_tail) and the plan lowers onto them, fold
+        # where the data sits — h2d is a few hundred bytes of literals
+        # and bin edges, never the columns. Any miss (not resident, plan
+        # not lowerable, kernel failure) falls through to the host path
+        # below, which is bit-identical by construction.
+        tail_key = getattr(batch, "_tail_key", None)
+        if tail_key is not None:
+            from tempo_tpu.ops import ingest_tail
+            fold_plan = ingest_tail.lower_fold_plan(plan)
+            if fold_plan is not None:
+                delta = None
+                try:
+                    with q.lock:
+                        delta = ingest_tail.resident_fold(
+                            plan, fold_plan, batch, dictionary, q.series,
+                            key=tail_key)
+                        if delta is not None:
+                            bin_offset = start // step
+                            for (slot, b), c in delta.items():
+                                key = (slot, bin_offset + b, 0)
+                                q.counts[key] = q.counts.get(key, 0) + c
+                            self._prune(q, now)
+                            self._eval_alert(q, now)
+                except Exception:
+                    log.exception("resident tail fold failed; using the "
+                                  "host path")
+                    delta = None
+                if delta is not None:
+                    return
         with q.lock:
             res = eval_batch(plan, batch, dictionary, q.series)
             live = res.slots[res.slots >= 0]

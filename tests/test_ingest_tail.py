@@ -1,0 +1,356 @@
+"""Resident just-cut tail (ISSUE 18): park cut columns on device, fold
+and scan where they sit.
+
+Contract under test, leg by leg:
+
+1. PARKING — every cut lands in the DeviceTier's `ingest_tail` keyspace
+   under the WAL segment identity, live batches carry the key, and a
+   zero tail budget disables the whole plane (host path, no residue).
+2. EXACTNESS — the resident standing fold and the live-tail search mask
+   are bit-identical to the host arms (the lowering is conservative:
+   anything it cannot prove falls back to the host path, so identity
+   holds by construction — these tests prove the lowered cases agree).
+3. ECONOMY — resident folds/scans move no column payload h2d: the
+   avoided counter climbs by column bytes while the same kernels' h2d
+   stays at O(100 B) of literals and bin edges per dispatch.
+4. SAFETY — tail entries are the FIRST thing shed under budget
+   pressure (they re-materialize from the WAL for free; hot pages paid
+   admission to get in), and a crash-restart with faults armed and
+   device encode on loses nothing.
+"""
+
+import numpy as np
+import pytest
+
+from tempo_tpu.app import App, AppConfig
+from tempo_tpu.db import DBConfig
+from tempo_tpu.encoding.common import SearchRequest
+from tempo_tpu.encoding.vtpu import colcache
+from tempo_tpu.metrics_engine.plan import compile_metrics_plan
+from tempo_tpu.model import synth
+from tempo_tpu.ops import ingest_tail
+from tempo_tpu.util import devicetiming
+
+RATE_BY_Q = "{} | rate() by (resource.service.name)"
+HIST_Q = "{} | histogram_over_time(duration)"
+
+
+@pytest.fixture
+def tier_reset():
+    """App startup installs the process-wide tier from config; make sure
+    no test leaves one behind for the rest of the suite."""
+    yield
+    colcache._shared_device = None
+
+
+def _mk_app(tmp, tail=True, **kw):
+    """App with the device tier + ingest-tail budget configured the way
+    an operator would (config section, not test backdoors)."""
+    if tail:
+        kw.setdefault("device_tier", colcache.DeviceTierConfig(
+            budget_mb=64, ingest_tail_budget_mb=32))
+    return App(AppConfig(
+        db=DBConfig(backend="local", backend_path=str(tmp / "blocks"),
+                    wal_path=str(tmp / "wal")),
+        generator_enabled=False, **kw,
+    ))
+
+
+def _aligned_base(step=60, ago_s=600):
+    import time
+
+    return (int(time.time()) // step) * step - ago_s
+
+
+def _cut_all(app):
+    for ing in app.ingesters.values():
+        for inst in list(ing.instances.values()):
+            inst.cut_complete_traces(immediate=True)
+
+
+def _vals(mat):
+    return sorted(
+        (tuple(sorted(r["metric"].items())), tuple(map(tuple, r["values"])))
+        for r in mat["result"]
+    )
+
+
+def _ids(resp):
+    return {t.trace_id_hex for t in resp.traces}
+
+
+def _h2d(kernel):
+    return devicetiming.transfer_bytes_total.value(direction="h2d",
+                                                   kernel=kernel)
+
+
+def _avoided(kernel):
+    return devicetiming.transfer_avoided_bytes_total.value(kernel=kernel)
+
+
+# ---------------------------------------------------------------------------
+# 1. parking
+# ---------------------------------------------------------------------------
+
+
+class TestParking:
+    def test_cut_parks_tail_under_wal_identity(self, tmp_path, tier_reset):
+        app = _mk_app(tmp_path)
+        tier = colcache.shared_device_tier()
+        assert tier is not None
+        try:
+            app.push_traces(synth.make_traces(8, seed=1, spans_per_trace=4))
+            _cut_all(app)
+            st = tier.stats()
+            assert st["tail_entries"] >= 1 and st["tail_bytes"] > 0
+            # live batches carry the key, and the key resolves
+            seen = 0
+            for ing in app.ingesters.values():
+                for inst in ing.instances.values():
+                    for b in inst.live_batches():
+                        key = getattr(b, "_tail_key", None)
+                        assert key is not None
+                        assert colcache.is_tail_key(key)
+                        entry = tier.get(key)
+                        assert entry is not None
+                        assert entry.meta["n"] == b.num_spans
+                        seen += 1
+            assert seen >= 1
+        finally:
+            app.shutdown()
+
+    def test_zero_budget_disables_parking(self, tmp_path):
+        tier = colcache.DeviceTier(8 << 20, refresh_s=3600.0,
+                                   ingest_tail_budget_bytes=0)
+        from tempo_tpu.model import trace as tr
+
+        batch = tr.traces_to_batch(synth.make_traces(3, seed=2))
+        assert ingest_tail.park_cut(tier, "t", "b:0", batch) is None
+        assert ingest_tail.park_cut(None, "t", "b:0", batch) is None
+        assert tier.stats()["tail_entries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# 2+3. resident standing fold: exactness + economy
+# ---------------------------------------------------------------------------
+
+
+class TestResidentFold:
+    def test_standing_read_matches_query_range(self, tmp_path, tier_reset):
+        app = _mk_app(tmp_path)
+        try:
+            base = _aligned_base()
+            doc = app.standing_register({"q": RATE_BY_Q, "step": 60,
+                                         "window": 3600})
+            h2d0, av0 = _h2d("standing_fold"), _avoided("standing_fold")
+            app.push_traces(synth.make_traces(
+                12, seed=5, spans_per_trace=4, base_time_ns=base * 10**9))
+            _cut_all(app)
+            start, end = base - 60, base + 120
+            assert _vals(app.standing_read(doc["id"], start_s=start,
+                                           end_s=end)) \
+                == _vals(app.query_range(RATE_BY_Q, start, end, 60))
+            # the fold ran resident: avoided climbed by column bytes,
+            # h2d moved only literals + bin edges (never the columns)
+            assert _avoided("standing_fold") > av0
+            assert _h2d("standing_fold") - h2d0 < 64 << 10
+        finally:
+            app.shutdown()
+
+    def test_unsupported_plan_falls_back_identically(self, tmp_path,
+                                                     tier_reset):
+        """histogram_over_time does not lower; with the tail resident the
+        host fold still runs and stays exact — and the resident fold
+        kernel never fires for it."""
+        app = _mk_app(tmp_path)
+        try:
+            base = _aligned_base()
+            doc = app.standing_register({"q": HIST_Q, "step": 60,
+                                         "window": 3600})
+            av0 = _avoided("standing_fold")
+            app.push_traces(synth.make_traces(
+                6, seed=9, spans_per_trace=5, base_time_ns=base * 10**9))
+            _cut_all(app)
+            start, end = base - 60, base + 120
+            assert _vals(app.standing_read(doc["id"], start_s=start,
+                                           end_s=end)) \
+                == _vals(app.query_range(HIST_Q, start, end, 60))
+            assert _avoided("standing_fold") == av0
+        finally:
+            app.shutdown()
+
+
+class TestFoldLowering:
+    def _plan(self, q):
+        return compile_metrics_plan(q, 0, 600, 60)
+
+    def test_lowers_dedicated_conjunction(self):
+        fp = ingest_tail.lower_fold_plan(self._plan(
+            '{ resource.service.name = "api" && span.http.status_code >= 500 }'
+            " | rate() by (name)"))
+        assert fp is not None
+        assert fp.by_col == "name"
+        assert [(c, op, k) for c, op, k, _ in fp.preds] \
+            == [("service", "=", "str"), ("http_status", ">=", "num")]
+
+    def test_lowers_empty_filter_no_by(self):
+        fp = ingest_tail.lower_fold_plan(self._plan("{} | count_over_time()"))
+        assert fp is not None and fp.preds == () and fp.by_col is None
+
+    @pytest.mark.parametrize("q", [
+        # histogram: host-only fold
+        "{} | histogram_over_time(duration)",
+        # attr-table column
+        '{ span.custom = "x" } | rate()',
+        # `any` scope shadows the attribute table
+        '{ .service.name = "api" } | rate()',
+        # by() on an attr-table column
+        "{} | rate() by (span.custom)",
+        # disjunction
+        '{ name = "a" || name = "b" } | rate()',
+    ])
+    def test_conservative_cases_stay_host(self, q):
+        assert ingest_tail.lower_fold_plan(self._plan(q)) is None
+
+
+# ---------------------------------------------------------------------------
+# 2+3. live-tail search: exactness + economy
+# ---------------------------------------------------------------------------
+
+
+class TestLiveTailSearch:
+    def _svc(self, traces):
+        return next(t.batches[0][0]["service.name"] for t in traces
+                    if t.batches[0][0].get("service.name"))
+
+    def test_device_and_host_arms_agree(self, tmp_path, tier_reset):
+        app = _mk_app(tmp_path)
+        tier = colcache.shared_device_tier()
+        try:
+            traces = synth.make_traces(15, seed=11, spans_per_trace=4)
+            app.push_traces(traces)
+            _cut_all(app)
+            reqs = [
+                SearchRequest(tags={"service.name": self._svc(traces)}),
+                SearchRequest(tags={"service.name": self._svc(traces)},
+                              min_duration_ns=10**6),
+                SearchRequest(min_duration_ns=1, max_duration_ns=10**12),
+                SearchRequest(tags={"service.name": "no-such-service"}),
+            ]
+            h2d0, av0 = _h2d("live_tail_scan"), _avoided("live_tail_scan")
+            dev = [_ids(app.search(r)) for r in reqs]
+            assert _avoided("live_tail_scan") > av0
+            assert _h2d("live_tail_scan") - h2d0 < 64 << 10
+            # host arm: same app, tier uninstalled -> mask falls back
+            colcache._shared_device = None
+            host = [_ids(app.search(r)) for r in reqs]
+            assert dev == host
+            assert dev[0], "fixture found no spans for the service tag"
+        finally:
+            colcache._shared_device = tier
+            app.shutdown()
+
+    def test_attr_table_tag_uses_host_path(self, tmp_path, tier_reset):
+        """A tag outside the dedicated columns cannot be proven on the
+        parked tail; the querier must take the host path (and still
+        answer) rather than return a wrong resident mask."""
+        app = _mk_app(tmp_path)
+        try:
+            app.push_traces(synth.make_traces(6, seed=13, spans_per_trace=3))
+            _cut_all(app)
+            av0 = _avoided("live_tail_scan")
+            app.search(SearchRequest(tags={"some.custom.attr": "v"}))
+            assert _avoided("live_tail_scan") == av0
+        finally:
+            app.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 4. safety: shed order + crash-restart with faults and device encode
+# ---------------------------------------------------------------------------
+
+
+class TestShedOrder:
+    def test_tail_sheds_before_hot_pages(self):
+        tier = colcache.DeviceTier(1 << 30, refresh_s=3600.0,
+                                   ingest_tail_budget_bytes=1 << 29)
+        tier.should_admit = lambda page_keys: True
+        hot = np.arange(1024, dtype=np.uint32)
+        assert tier.offer(("blk", "service", 0), "rle", {"values": hot})
+        assert tier.offer(("blk", "name", 0), "rle", {"values": hot})
+        for i in range(4):
+            assert tier.park_tail(ingest_tail.tail_key("t", f"b:{i}"),
+                                  {"service": hot.copy()})
+        st = tier.stats()
+        assert st["tail_entries"] == 4 and st["entries"] == 6
+        # budget collapses to just the two hot pages: every tail entry
+        # must go before ANY hot page does
+        tier.budget_bytes = 2 * hot.nbytes
+        tier.shed()
+        st = tier.stats()
+        assert st["tail_entries"] == 0 and st["tail_bytes"] == 0
+        assert st["entries"] == 2
+        assert tier.get(("blk", "service", 0)) is not None
+
+    def test_resident_pages_listing_survives_tail_keys(self):
+        """/status/device regression: tail keys carry a string WAL
+        segment identity in slot 2 where page keys carry an int offset —
+        the MRU listing must render both, never int() the segment."""
+        tier = colcache.DeviceTier(1 << 30, refresh_s=3600.0,
+                                   ingest_tail_budget_bytes=1 << 29)
+        tier.should_admit = lambda page_keys: True
+        arr = np.arange(256, dtype=np.uint32)
+        assert tier.offer(("blk", "service", 0), "rle", {"values": arr})
+        seg = "96217c95-0c3f-416c-9b57-896e6e9d705f:1"
+        assert tier.park_tail(ingest_tail.tail_key("t", seg),
+                              {"service": arr.copy()})
+        rows = tier.resident_pages()
+        tail_rows = [r for r in rows if r.get("keyspace") == "ingest_tail"]
+        page_rows = [r for r in rows if "offset" in r]
+        assert tail_rows and tail_rows[0]["segment"] == seg
+        assert page_rows and page_rows[0]["column"] == "service"
+
+
+class TestCrashRestart:
+    def test_restart_with_faults_and_device_encode(self, tmp_path,
+                                                   monkeypatch, tier_reset):
+        """Flush with the device encoders armed, crash before the final
+        flush, restart behind a fault-injecting backend: WAL replay +
+        block reads converge and the standing answer is unchanged —
+        device-encoded pages are indistinguishable from host pages to
+        every reader, including the recovery path."""
+        monkeypatch.setenv("TEMPO_TPU_DEVICE_ENCODE", "1")
+        from tempo_tpu.ops import encode as dev_enc
+
+        base = _aligned_base()
+        app = _mk_app(tmp_path)
+        doc = app.standing_register({"q": RATE_BY_Q, "step": 60,
+                                     "window": 3600})
+        pages0 = dev_enc.device_encode_pages_total.total()
+        app.push_traces(synth.make_traces(
+            12, seed=3, spans_per_trace=4, base_time_ns=base * 10**9))
+        _cut_all(app)
+        for ing in app.ingesters.values():
+            for inst in list(ing.instances.values()):
+                inst.cut_block_if_ready(immediate=True)
+                inst.complete_and_flush()
+        assert dev_enc.device_encode_pages_total.total() > pages0, \
+            "flush did not exercise the device encode arm"
+        app.push_traces(synth.make_traces(
+            5, seed=4, spans_per_trace=4, base_time_ns=(base + 60) * 10**9))
+        _cut_all(app)  # second wave stays WAL-only
+        app.standing.snapshot()
+        start, end = base - 60, base + 180
+        expect = _vals(app.query_range(RATE_BY_Q, start, end, 60))
+        for ing in app.ingesters.values():
+            ing.stop(flush=False)  # crash: no final flush
+        monkeypatch.setenv("TEMPO_TPU_FAULTS", "read=0.05,seed=11")
+        app2 = _mk_app(tmp_path)
+        try:
+            got = app2.standing_read(doc["id"], start_s=start, end_s=end)
+            assert _vals(got) == expect, \
+                "acknowledged spans lost across crash-restart"
+            assert _vals(app2.query_range(RATE_BY_Q, start, end, 60)) \
+                == expect
+        finally:
+            app2.shutdown()

@@ -214,6 +214,12 @@ FAMILY_SERIES_BUDGETS = {
     "tempo_tpu_graph_unpaired_spans_total": 2,
     "tempo_tpu_graph_walk_steps_total": 2,
     "tempo_tpu_graph_queries_total": 8,
+    # device-native ingest plane: decode path enum (columnar | object) and
+    # codec enums (rle | dct | dbp) — tenants/columns must NEVER become
+    # labels here; per-tenant ingest cost lives in the usage counters
+    "tempo_tpu_ingest_spans_decoded_total": 4,
+    "tempo_tpu_ingest_device_encode_pages_total": 8,
+    "tempo_tpu_ingest_encode_fallback_total": 8,
     # tenant x kind cost counters (usage accountant eviction bounds tenant)
     **{f"tempo_tpu_usage_{f}_total": 448 for f in (
         "ingested_bytes", "ingested_spans", "flushed_bytes",

@@ -303,6 +303,89 @@ class TestShim:
             receivers.decompress_body(raw, "br")
 
 
+class TestColumnarDecode:
+    """The batched columnar fast path must be invisible to everything
+    downstream: the SpanBatch it builds straight off the wire carries
+    the same spans, field for field, as the object decode would have."""
+
+    def _assert_same(self, batch, want_traces):
+        from tempo_tpu.model import trace as tr
+
+        assert batch.num_spans == sum(t.span_count() for t in want_traces)
+        want = _span_index(want_traces)
+        got = _span_index(tr.batch_to_traces(batch))
+        assert set(got) == set(want)
+        for sid, (resource, s) in want.items():
+            r2, s2 = got[sid]
+            assert r2 == resource
+            assert s2.name == s.name
+            assert s2.trace_id == s.trace_id
+            assert s2.parent_span_id == s.parent_span_id
+            assert s2.start_unix_nano == s.start_unix_nano
+            assert s2.duration_nano == s.duration_nano
+            assert s2.kind == s.kind
+            assert s2.status_code == s.status_code
+            assert s2.attributes == s.attributes
+
+    def test_proto_parity_with_object_decode(self):
+        traces = [make_trace(seed=i, n_spans=5) for i in range(4)]
+        body = otlp.encode_traces_request(traces)
+        batch = receivers.decode_http_columnar(
+            "/v1/traces", "application/x-protobuf", body)
+        assert batch is not None
+        self._assert_same(batch, receivers.decode_http(
+            "/v1/traces", "application/x-protobuf", body))
+
+    def test_json_parity_with_object_decode(self):
+        body = json.dumps({
+            "resourceSpans": [{
+                "resource": {"attributes": [
+                    {"key": "service.name",
+                     "value": {"stringValue": "shop"}}]},
+                "scopeSpans": [{"spans": [
+                    {"traceId": "0102030405060708090a0b0c0d0e0f10",
+                     "spanId": "0102030405060708",
+                     "name": "GET /",
+                     "kind": "SPAN_KIND_SERVER",
+                     "startTimeUnixNano": "1000",
+                     "endTimeUnixNano": "3000",
+                     "status": {"code": "STATUS_CODE_ERROR"},
+                     "attributes": [
+                         {"key": "http.method",
+                          "value": {"stringValue": "GET"}},
+                         {"key": "retries", "value": {"intValue": "3"}},
+                     ]},
+                    {"traceId": "0102030405060708090a0b0c0d0e0f10",
+                     "spanId": "1112131415161718",
+                     "parentSpanId": "0102030405060708",
+                     "name": "db query",
+                     "startTimeUnixNano": "1500",
+                     "endTimeUnixNano": "2500"},
+                ]}],
+            }]
+        }).encode()
+        batch = receivers.decode_http_columnar(
+            "/v1/traces", "application/json", body)
+        assert batch is not None
+        self._assert_same(batch, receivers.decode_http(
+            "/v1/traces", "application/json", body))
+
+    def test_non_otlp_declines_to_object_path(self):
+        body = json.dumps([{"traceId": "ab", "id": "01", "name": "z"}]).encode()
+        assert receivers.decode_http_columnar(
+            "/api/v2/spans", "application/json", body) is None
+
+    def test_decode_path_counter_splits_arms(self):
+        body = otlp.encode_traces_request([make_trace(seed=9, n_spans=3)])
+        col0 = receivers.spans_decoded_total.value(path="columnar")
+        obj0 = receivers.spans_decoded_total.value(path="object")
+        receivers.decode_http_columnar(
+            "/v1/traces", "application/x-protobuf", body)
+        assert receivers.spans_decoded_total.value(path="columnar") == col0 + 3
+        receivers.decode_http("/v1/traces", "application/x-protobuf", body)
+        assert receivers.spans_decoded_total.value(path="object") == obj0 + 3
+
+
 # --- zipkin v1 thrift ------------------------------------------------------
 
 

@@ -22,17 +22,21 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
 rc=${PIPESTATUS[0]}
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
 
-# Hot-tier + compiled-tier smoke (ISSUES 16/17): tiny loadtest with a
-# repeat-query arm (device-resident tier serves repeats without
-# re-shipping pages: h2d flat, resident hits climbing, transfer-stage
-# << kernel-stage) and a literal-rotation arm (the compiled tier's
-# shape cache re-enters the traced executable across literal/window
-# swaps: zero retraces, shape hits climbing, fused path dispatching).
+# Hot-tier + compiled-tier + ingest-plane smoke (ISSUES 16/17/18): tiny
+# loadtest with a repeat-query arm (device-resident tier serves repeats
+# without re-shipping pages: h2d flat, resident hits climbing,
+# transfer-stage << kernel-stage), a literal-rotation arm (the compiled
+# tier's shape cache re-enters the traced executable across
+# literal/window swaps: zero retraces, shape hits climbing, fused path
+# dispatching), and a write-burst arm (device encode armed fleet-wide,
+# just-cut tails resident: standing-fold + live-tail h2d flat while
+# avoided bytes climb, device-encoded pages flushing, zero acked loss).
 # Generous rss limit: a 6s run is all startup transient.
 hot_rc=0
 if [ "$rc" -eq 0 ]; then
   timeout -k 10 420 python tools/loadtest.py --duration 6 --rate 1 \
     --skip-sweep --slo-scale 8 --rss-growth-limit 3.0 --hot 6 --shapes 4 \
+    --ingest-heavy \
     >/tmp/_t1_hot.json 2>/tmp/_t1_hot.log
   hot_rc=$?
   if [ "$hot_rc" -ne 0 ]; then

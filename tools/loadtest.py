@@ -91,7 +91,7 @@ metrics_generator:
 
 class Proc:
     def __init__(self, tmp, target, name, kv_url, grpc_port=0, extra="",
-                 multitenant=False):
+                 multitenant=False, env_extra=None):
         self.name = name
         self.port = _free_port()
         self.url = f"http://127.0.0.1:{self.port}"
@@ -100,7 +100,7 @@ class Proc:
             f.write(_cfg(tmp, target, self.port, name, kv_url, grpc_port, extra,
                          multitenant=multitenant))
         self.log = open(f"{tmp}/{name}.log", "w")
-        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env = dict(os.environ, JAX_PLATFORMS="cpu", **(env_extra or {}))
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "tempo_tpu", f"-config.file={cfg_path}"],
             stdout=self.log, stderr=subprocess.STDOUT, env=env,
@@ -132,28 +132,31 @@ class Proc:
 
 def start_cluster(tmp: str, grpc_port: int = 0,
                   multitenant: bool = False,
-                  extra: str = "") -> tuple[list[Proc], Proc, Proc]:
+                  extra: str = "",
+                  env_extra: dict | None = None) -> tuple[list[Proc], Proc, Proc]:
     """-> (all procs, frontend/query entry, distributor entry).
 
     The frontend hosts the ring KV service ("local") and every other
     role joins through it — the same bootstrap the multi-process e2e
     test uses. `extra` is appended to every process's config (the --hot
-    arm uses it to enable the device-resident tier fleet-wide)."""
+    arm uses it to enable the device-resident tier fleet-wide);
+    `env_extra` lands in every process's environment (the
+    --ingest-heavy arm arms TEMPO_TPU_DEVICE_ENCODE fleet-wide)."""
     front = Proc(tmp, "query-frontend", "front", kv_url="local",
-                 multitenant=multitenant, extra=extra)
+                 multitenant=multitenant, extra=extra, env_extra=env_extra)
     front.wait_ready()
     kv_url = front.url
     procs = [front]
     procs.append(Proc(tmp, "ingester", "ing-a", kv_url, multitenant=multitenant,
-                      extra=extra))
+                      extra=extra, env_extra=env_extra))
     procs.append(Proc(tmp, "ingester", "ing-b", kv_url, multitenant=multitenant,
-                      extra=extra))
+                      extra=extra, env_extra=env_extra))
     dist = Proc(tmp, "distributor", "dist", kv_url, grpc_port=grpc_port,
-                multitenant=multitenant, extra=extra)
+                multitenant=multitenant, extra=extra, env_extra=env_extra)
     procs.append(dist)
     procs.append(Proc(tmp, "querier", "querier", kv_url,
                       extra=f"frontend_address: {kv_url}\n" + extra,
-                      multitenant=multitenant))
+                      multitenant=multitenant, env_extra=env_extra))
     for p in procs[1:]:
         p.wait_ready()
     time.sleep(1.0)  # let ring heartbeats settle
@@ -1181,6 +1184,222 @@ def compiled_shapes_probe(query_url: str, scrape_urls: list,
     }
 
 
+# ---------------------------------------------------------------------------
+# --ingest-heavy arm: write-dominated burst against the device-native
+# ingest plane (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+# appended to every process config in --ingest-heavy mode: the hot-tier
+# budget plus an ingest_tail share so just-cut columns stay resident for
+# standing folds and live-tail search; refresh/admission match the --hot
+# snippet so both arms can share one cluster.
+INGEST_TAIL_EXTRA = """device_tier:
+  budget_mb: 64
+  ingest_tail_budget_mb: 32
+  refresh_s: 1.0
+  admit_min_ships: 2
+"""
+
+# the two kernels that must evaluate where the cut landed (resident),
+# never re-shipping the column payloads they read
+INGEST_KERNELS = ("standing_fold", "live_tail_scan")
+
+
+def _scrape_ingest(urls: list) -> dict:
+    """Sum the ingest-plane gate's families across processes."""
+    out = {"h2d_bytes": 0.0, "avoided_bytes": 0.0, "dispatches": 0.0,
+           "spans_columnar": 0.0, "spans_object": 0.0,
+           "device_pages": 0.0, "encode_fallbacks": 0.0,
+           "blocks_flushed": 0.0}
+    for _name, url in urls:
+        try:
+            with urllib.request.urlopen(url + "/metrics", timeout=15) as r:
+                met = r.read().decode()
+        except Exception:  # noqa: BLE001 — a dead proc fails the gates anyway
+            continue
+        for line in met.splitlines():
+            try:
+                val = float(line.rsplit(" ", 1)[1])
+            except (ValueError, IndexError):
+                continue
+            resident = any(f'kernel="{k}"' in line for k in INGEST_KERNELS)
+            if (line.startswith("tempo_tpu_device_transfer_bytes_total")
+                    and 'direction="h2d"' in line and resident):
+                out["h2d_bytes"] += val
+            elif (line.startswith(
+                    "tempo_tpu_device_transfer_bytes_avoided_total")
+                    and resident):
+                out["avoided_bytes"] += val
+            elif (line.startswith("tempo_tpu_device_dispatches_total")
+                    and resident):
+                out["dispatches"] += val
+            elif line.startswith("tempo_tpu_ingest_spans_decoded_total"):
+                key = ("spans_columnar" if 'path="columnar"' in line
+                       else "spans_object")
+                out[key] += val
+            elif line.startswith("tempo_tpu_ingest_device_encode_pages_total"):
+                out["device_pages"] += val
+            elif line.startswith("tempo_tpu_ingest_encode_fallback_total"):
+                out["encode_fallbacks"] += val
+            elif line.startswith("tempo_ingester_blocks_flushed_total"):
+                out["blocks_flushed"] += val
+    return out
+
+
+def ingest_heavy_probe(write_url: str, query_url: str, ing_urls: list,
+                       scrape_urls: list, target_spans_s: float,
+                       tenant: str | None = None, spans_per_trace: int = 8,
+                       burst_s: float = 4.0, writers: int = 4) -> dict:
+    """Write-dominated arm (the 100x ingest mix distilled): standing
+    queries registered up front, then a full-throttle OTLP burst —
+    writers push back-to-back with no pacing — with live-tail searches
+    riding beside it, then a drain long enough for every burst trace to
+    cut (parking its columnar tail and folding the standing queries
+    where it sits). Gates:
+
+    - spans/s/chip >= `target_spans_s` over the burst window (acked
+      spans only; sheds are backpressure, not throughput). The cluster
+      procs are pinned to the CPU backend, so chips == 1 here — on a
+      real TPU fleet the target scales with the chip count.
+    - resident evaluation: standing_fold AND live_tail_scan h2d bytes
+      stay at dispatch-literal noise (predicate codes / bin edges, a few
+      bytes per dispatch) while their avoided-bytes counters climb —
+      the folds and tail searches ran where the cut landed, the column
+      payloads never re-shipped.
+    - the batched columnar decode path carried the burst
+      (path="columnar" spans >= the acked burst spans) and the device
+      encode arm produced the flushed pages
+      (`tempo_tpu_ingest_device_encode_pages_total` climbing, blocks
+      actually flushed).
+    - zero acked-span loss across the burst, via the same verify_acked
+      gate the mixed load uses.
+    """
+    import random
+    import threading
+
+    from tempo_tpu.model import synth
+    from tempo_tpu.receivers import otlp
+
+    # standing queries first, so the burst's cuts fold through them;
+    # {} | count_over_time() lowers to the resident fold plan
+    for url in ing_urls:
+        try:
+            _http_json(f"{url}/api/metrics/standing", method="POST",
+                       body={"q": "{} | count_over_time()", "step": 60,
+                             "window": 7 * 86400}, tenant=tenant)
+        except Exception as e:  # noqa: BLE001 — gate reports, caller decides
+            return {"error": f"standing registration failed: {e}",
+                    "passed": False}
+
+    stop_search = threading.Event()
+    searches = [0]
+
+    def searcher():
+        # now-window: the burst below stamps its spans at the wall clock
+        # (unlike the epoch-pinned mixed load) so the searches land on
+        # the live/just-cut tail, not on historical blocks
+        rng = random.Random(4242)
+        while not stop_search.wait(0.25):
+            now = int(time.time())
+            svc = rng.choice(synth.SERVICES)
+            qs = urllib.parse.urlencode({
+                "tags": f"service.name={svc}",
+                "start": now - 300, "end": now + 5, "limit": 10})
+            try:
+                _get_json(f"{query_url}/api/search?{qs}", timeout=30,
+                          headers=_org(tenant))
+                searches[0] += 1
+            except Exception:  # noqa: BLE001 — gates read the counters
+                pass
+
+    base = _scrape_ingest(scrape_urls)
+    s_thread = threading.Thread(target=searcher, daemon=True)
+    s_thread.start()
+
+    acked: list = []
+    acked_lock = threading.Lock()
+    shed = [0]
+    seq_lock = threading.Lock()
+    seq = [0]
+    deadline = time.monotonic() + burst_s
+
+    def blast():
+        while time.monotonic() < deadline:
+            with seq_lock:
+                seq[0] += 1
+                i = seq[0]
+            # wall-clock timestamps: the standing accumulator prunes
+            # bins outside its window, so epoch-pinned spans would never
+            # fold — and folds are exactly what this arm gates on
+            traces = synth.make_traces(2, seed=31_000_000 + i,
+                                       spans_per_trace=spans_per_trace,
+                                       base_time_ns=time.time_ns())
+            body = otlp.encode_traces_request(traces)
+            try:
+                status, _ = _request(write_url + "/v1/traces", "POST", body,
+                                     "application/x-protobuf", timeout=30,
+                                     headers=_org(tenant))
+            except Exception:  # noqa: BLE001 — a refused write is not acked
+                continue
+            if 200 <= status < 300:
+                with acked_lock:
+                    acked.extend((tenant, t.trace_id) for t in traces)
+            elif status == 429:
+                shed[0] += 1
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=blast, daemon=True)
+               for _ in range(writers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    burst_wall = time.monotonic() - t0
+
+    # drain: max_trace_idle 1s + flush_check 1s -> every burst trace
+    # cuts, parking its tail and folding the standing queries; the
+    # live-tail searches keep firing over the freshly-parked window
+    time.sleep(3.0)
+    stop_search.set()
+    s_thread.join(timeout=5)
+    after = _scrape_ingest(scrape_urls)
+
+    delta = {k: after[k] - base[k] for k in after}
+    n_traces = len(acked)
+    spans = n_traces * spans_per_trace
+    chips = 1  # cluster procs run JAX_PLATFORMS=cpu; scale target on TPU
+    spans_s = spans / max(burst_wall, 1e-9) / chips
+    # "flat" = dispatch-literal noise only: each resident dispatch still
+    # ships O(bytes) of predicate codes / bin edges, never the columns
+    h2d_allow = max(64 << 10, 4096.0 * delta["dispatches"])
+    loss = verify_acked(query_url, acked)
+    gates = {
+        "spans_per_s": spans_s >= target_spans_s,
+        "h2d_flat": delta["h2d_bytes"] <= h2d_allow,
+        "avoided_climb": delta["avoided_bytes"] > 0,
+        "resident_dispatches": delta["dispatches"] > 0,
+        "columnar_decode": delta["spans_columnar"] >= spans > 0,
+        "device_encode_live": delta["device_pages"] > 0,
+        "flushed": delta["blocks_flushed"] > 0,
+        "zero_acked_loss": loss["passed"],
+    }
+    return {
+        "acked_traces": n_traces,
+        "shed_writes": shed[0],
+        "spans": spans,
+        "burst_s": round(burst_wall, 3),
+        "spans_per_s_per_chip": round(spans_s, 1),
+        "target_spans_s": target_spans_s,
+        "chips": chips,
+        "live_tail_searches": searches[0],
+        "delta": {k: round(v, 1) for k, v in delta.items()},
+        "h2d_allowance_bytes": h2d_allow,
+        "acked_loss": loss,
+        "gates": gates,
+        "passed": all(gates.values()),
+    }
+
+
 def storage_summary(query_url: str) -> dict:
     """Fleet storage health from the frontend's /status/storage — the
     same compression/debt/zone-map numbers bench_suite emits, so CI
@@ -1363,6 +1582,18 @@ def main() -> int:
                          "windows, gated on zero program retraces across "
                          "the rotation, shape-cache hits climbing, and "
                          "the fused path actually dispatching")
+    ap.add_argument("--ingest-heavy", action="store_true",
+                    help="enable the device-native ingest plane fleet-wide "
+                         "(device encode armed, ingest-tail residency on) "
+                         "and run a write-dominated burst arm after the "
+                         "drain, gated on spans/s/chip >= --ingest-target, "
+                         "standing-fold + live-tail h2d flat while avoided "
+                         "bytes climb, device-encoded pages flushing, and "
+                         "zero acked-span loss")
+    ap.add_argument("--ingest-target", type=float, default=300.0,
+                    help="spans/s/chip floor for the --ingest-heavy burst "
+                         "(default sized for shared-core CI on the CPU "
+                         "backend; raise it on real chips)")
     ap.add_argument("--tenants", type=int, default=1,
                     help=">1 enables multi-tenant mode: the cluster boots "
                          "with multitenancy, every op carries one of N org "
@@ -1386,9 +1617,15 @@ def main() -> int:
             write_url = query_url = args.url
         else:
             tmpdir = tempfile.mkdtemp(prefix="tempo-loadtest-")
+            # INGEST_TAIL_EXTRA is a superset of HOT_TIER_EXTRA (same
+            # tier, plus the ingest_tail share), so both arms share it
+            extra = (INGEST_TAIL_EXTRA if args.ingest_heavy
+                     else HOT_TIER_EXTRA if args.hot > 0 else "")
+            env_extra = ({"TEMPO_TPU_DEVICE_ENCODE": "1"}
+                         if args.ingest_heavy else None)
             procs, front, dist = start_cluster(
                 tmpdir, grpc_port=grpc_port, multitenant=multitenant,
-                extra=HOT_TIER_EXTRA if args.hot > 0 else "")
+                extra=extra, env_extra=env_extra)
             write_url, query_url = dist.url, front.url
             print(f"[loadtest] cluster up: write={write_url} query={query_url}"
                   + (f" tenants={args.tenants}" if multitenant else ""),
@@ -1482,6 +1719,19 @@ def main() -> int:
             hot_ok = summary["hot_tier"]["passed"]
             print(f"[loadtest] hot-tier gate: {summary['hot_tier']}",
                   file=sys.stderr)
+        ingest_ok = True
+        if args.ingest_heavy:
+            ing_urls = [p.url for p in procs if p.name.startswith("ing")]
+            if not ing_urls:
+                ing_urls = [write_url]  # --url mode: single target
+            summary["ingest_heavy"] = ingest_heavy_probe(
+                write_url, query_url, ing_urls, check_urls,
+                target_spans_s=args.ingest_target,
+                tenant=tenant_ids[0] if tenant_ids else None,
+                spans_per_trace=max(args.spans_per_trace, 8))
+            ingest_ok = summary["ingest_heavy"]["passed"]
+            print(f"[loadtest] ingest-heavy gate: {summary['ingest_heavy']}",
+                  file=sys.stderr)
         shapes_ok = True
         if args.shapes > 0:
             summary["compiled_shapes"] = compiled_shapes_probe(
@@ -1498,6 +1748,7 @@ def main() -> int:
             and standing_ok
             and device_ok
             and hot_ok
+            and ingest_ok
             and shapes_ok
             and (rss is None or summary["rss"]["passed"])
         )
