@@ -1271,6 +1271,89 @@ def _stats(times: list[float]) -> tuple[float, float]:
     return med, (float((q3 - q1) / med) if med else 0.0)
 
 
+def _result_cache_rep(reps: int = 3) -> dict:
+    """Result-cache rep (BENCH_r07+, ISSUE 19): the repeated-dashboard
+    lever. One frozen search + one frozen query_range over stored
+    blocks, cold arm (cache killed, page cache cleared per rep — every
+    rep pays decode + IO) vs warm arm (cache forced, partials served
+    per block). INTERLEAVED cold/warm with paired per-rep ratios, bit
+    identity asserted every rep, bytes-saved per warm pass read from
+    the same counter the dashboards chart."""
+    from tempo_tpu import resultcache as rc_mod
+    from tempo_tpu.backend import MockBackend
+    from tempo_tpu.db import DBConfig, TempoDB
+    from tempo_tpu.encoding.common import SearchRequest
+    from tempo_tpu.encoding.vtpu.colcache import shared_cache
+    from tempo_tpu.model import synth
+    from tempo_tpu.model import trace as tr
+    from tempo_tpu.modules.querier import Querier
+
+    base_s = 1_700_000_000
+    old_env = os.environ.get("TEMPO_TPU_RESULT_CACHE")
+    db = TempoDB(DBConfig(backend="mock"), raw_backend=MockBackend())
+    try:
+        for j in range(6):
+            ts = synth.make_traces(200, seed=1900 + j, spans_per_trace=6)
+            db.write_batch("bench", tr.traces_to_batch(ts).sorted_by_trace())
+        ids = [m.block_id for m in db.blocklist.metas("bench")]
+        qr = Querier(db)
+        req = SearchRequest(tags={"service": "cart"}, limit=200,
+                            start_seconds=base_s - 300,
+                            end_seconds=base_s + 300)
+        mq = "{ resource.service.name = `cart` } | rate()"
+
+        def run_once():
+            cache = shared_cache()
+            if cache is not None:
+                cache.clear()  # cold reps pay their own IO; warm never reads
+            s = qr.search_block_batch("bench", ids, req)
+            m = qr.query_range_blocks("bench", ids, mq,
+                                      base_s - 300, base_s + 300, 10)
+            return ([t.to_dict() for t in s.traces], m["series"],
+                    s.inspected_bytes + m["stats"]["inspectedBytes"])
+
+        os.environ["TEMPO_TPU_RESULT_CACHE"] = "0"
+        run_once()  # warmup: jit + lazy imports out of the timings
+        os.environ["TEMPO_TPU_RESULT_CACHE"] = "force"
+        run_once()  # prime: miss + store pass
+        t_cold, t_warm = [], []
+        cold_bytes = 0
+        saved0 = (rc_mod.rc_bytes_saved.total(kind="search")
+                  + rc_mod.rc_bytes_saved.total(kind="metrics"))
+        for _ in range(reps):
+            os.environ["TEMPO_TPU_RESULT_CACHE"] = "0"
+            t0 = time.perf_counter()
+            cold = run_once()
+            t_cold.append(time.perf_counter() - t0)
+            cold_bytes = cold[2]
+            os.environ["TEMPO_TPU_RESULT_CACHE"] = "force"
+            t0 = time.perf_counter()
+            warm = run_once()
+            t_warm.append(time.perf_counter() - t0)
+            assert cold[:2] == warm[:2], "result-cache warm arm diverged"
+            assert warm[2] == 0, f"warm pass read {warm[2]} bytes"
+        saved_per_rep = (rc_mod.rc_bytes_saved.total(kind="search")
+                         + rc_mod.rc_bytes_saved.total(kind="metrics")
+                         - saved0) / reps
+        cold_s = float(np.median(t_cold))
+        warm_s = float(np.median(t_warm))
+        return {
+            "blocks": len(ids),
+            "cold_s": round(cold_s, 4),
+            "warm_s": round(warm_s, 4),
+            "paired_cold_over_warm": round(float(np.median(
+                [c / w for c, w in zip(t_cold, t_warm)])), 3),
+            "cold_inspected_bytes": int(cold_bytes),
+            "bytes_saved_per_warm_pass": int(saved_per_rep),
+            "identical": True,  # asserted above, every rep
+        }
+    finally:
+        if old_env is None:
+            os.environ.pop("TEMPO_TPU_RESULT_CACHE", None)
+        else:
+            os.environ["TEMPO_TPU_RESULT_CACHE"] = old_env
+
+
 # ---------------------------------------------------------------------------
 # child: persistent CPU-baseline server, one rep per request so the
 # parent can interleave arms (host noise epochs hit all arms equally)
@@ -1571,6 +1654,13 @@ def _run(dog, partial: dict):
     partial["ingest"] = ingest_rep
     print(f"[bench] ingest: {ingest_rep}", file=sys.stderr)
 
+    # result cache: repeated identical queries, cold recompute vs
+    # cached shard partials, paired arms with bit-identity asserted
+    # (ISSUE 19 tentpole / BENCH_r07 fields)
+    result_cache_rep = _result_cache_rep()
+    partial["result_cache"] = result_cache_rep
+    print(f"[bench] result_cache: {result_cache_rep}", file=sys.stderr)
+
     med, spread = _stats(tpu_times)
     blocks_per_s = B_BLOCKS / med
     # paired per-rep ratios: epoch noise hits both arms of a pair, so the
@@ -1620,6 +1710,7 @@ def _run(dog, partial: dict):
         "hot_tier": hot_tier_rep,
         "compiled": compiled_rep,
         "ingest": ingest_rep,
+        "result_cache": result_cache_rep,
     }))
 
 

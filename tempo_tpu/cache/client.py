@@ -18,6 +18,10 @@ from tempo_tpu.util import metrics
 
 cache_hits = metrics.counter("tempo_cache_hits_total", "Cache fetch hits")
 cache_misses = metrics.counter("tempo_cache_misses_total", "Cache fetch misses")
+cache_evictions = metrics.counter(
+    "tempo_cache_evictions_total",
+    "In-process LRU cache entries evicted by the byte-size bound",
+)
 cache_dropped = metrics.counter(
     "tempo_cache_background_writes_dropped_total",
     "Write-behind queue overflow drops (reference: background.go droppedWriteBack)",
@@ -59,6 +63,7 @@ class LRUCache(Cache):
             while self._size > self.max_bytes and self._data:
                 _, evicted = self._data.popitem(last=False)
                 self._size -= len(evicted)
+                cache_evictions.inc()
 
     def fetch(self, keys):
         found, bufs, missed = [], [], []
@@ -129,11 +134,24 @@ class MemcachedCache(Cache):
     def _conn(self, addr: str) -> socket.socket:
         s = self._conns.get(addr)
         if s is not None:
+            # reused sockets must re-arm the deadline: create_connection's
+            # timeout only covers the connect, and a wedged server would
+            # otherwise hang the querier on recv forever
+            s.settimeout(self.timeout_s)
             return s
         host, port = addr.rsplit(":", 1)
         s = socket.create_connection((host, int(port)), timeout=self.timeout_s)
+        s.settimeout(self.timeout_s)
         self._conns[addr] = s
         return s
+
+    def _drop(self, addr: str) -> None:
+        s = self._conns.pop(addr, None)
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
 
     def _sendline(self, s: socket.socket, line: bytes) -> None:
         s.sendall(line + b"\r\n")
@@ -145,15 +163,20 @@ class MemcachedCache(Cache):
         with self._lock:
             for k, b in zip(keys, bufs):
                 addr = self._server_for(k)
-                try:
-                    s = self._conn(addr)
-                    s.sendall(
-                        b"set %s 0 %d %d\r\n%s\r\n" % (k.encode(), self.ttl_s, len(b), b)
-                    )
-                    f = s.makefile("rb")
-                    self._readline(f)  # STORED
-                except OSError:
-                    self._conns.pop(addr, None)
+                # one reconnect per key, then give up: a dead server costs
+                # at most 2 * timeout_s, never a wedged querier
+                for _attempt in (0, 1):
+                    try:
+                        s = self._conn(addr)
+                        s.sendall(
+                            b"set %s 0 %d %d\r\n%s\r\n"
+                            % (k.encode(), self.ttl_s, len(b), b)
+                        )
+                        f = s.makefile("rb")
+                        self._readline(f)  # STORED
+                        break
+                    except OSError:
+                        self._drop(addr)
 
     def fetch(self, keys):
         by_server: dict[str, list[str]] = {}
@@ -162,22 +185,25 @@ class MemcachedCache(Cache):
         got: dict[str, bytes] = {}
         with self._lock:
             for addr, ks in by_server.items():
-                try:
-                    s = self._conn(addr)
-                    self._sendline(s, b"get " + " ".join(ks).encode())
-                    f = s.makefile("rb")
-                    while True:
-                        line = self._readline(f)
-                        if line == b"END" or not line:
-                            break
-                        # VALUE <key> <flags> <bytes>
-                        parts = line.split()
-                        n = int(parts[3])
-                        data = f.read(n)
-                        f.read(2)  # trailing \r\n
-                        got[parts[1].decode()] = data
-                except OSError:
-                    self._conns.pop(addr, None)
+                # one reconnect per server, then degrade to miss
+                for _attempt in (0, 1):
+                    try:
+                        s = self._conn(addr)
+                        self._sendline(s, b"get " + " ".join(ks).encode())
+                        f = s.makefile("rb")
+                        while True:
+                            line = self._readline(f)
+                            if line == b"END" or not line:
+                                break
+                            # VALUE <key> <flags> <bytes>
+                            parts = line.split()
+                            n = int(parts[3])
+                            data = f.read(n)
+                            f.read(2)  # trailing \r\n
+                            got[parts[1].decode()] = data
+                        break
+                    except OSError:
+                        self._drop(addr)
         return _tally(keys, got)
 
     def stop(self) -> None:

@@ -456,6 +456,23 @@ def check_config(cfg: Config) -> list[str]:
                 "already oversubscribes — shrink the budget below the "
                 "headroom before enabling compiled execution"
             )
+    # -- result cache ------------------------------------------------------
+    if app.db.result_cache.enabled and app.db.cache == "none":
+        warnings.append(
+            "storage.trace.result_cache is enabled with cache: none — the "
+            "cache is in-process-LRU only, so replicas never share partials "
+            "and every restart starts cold (point cache: at the memcached/"
+            "redis pool the shard partials should ride)"
+        )
+    if app.db.result_cache.enabled and app.db.result_cache.negative and \
+            os.environ.get("TEMPO_TPU_ZONEMAPS", "").lower() in (
+                "0", "false", "no"):
+        warnings.append(
+            "result_cache.negative is on while TEMPO_TPU_ZONEMAPS disables "
+            "zone maps: provable-emptiness comes from zone/window pruning, "
+            "so no veto can ever be cached (stats-less legacy blocks have "
+            "the same blind spot) — the negative tier silently never fires"
+        )
     if app.slo.enabled:
         for obj in (app.slo.objectives or slo_mod.default_objectives()):
             if obj.sli not in slo_mod.SLI_SOURCES:

@@ -34,6 +34,7 @@ from tempo_tpu.encoding.common import (
     SearchResponse,
 )
 from tempo_tpu.model.trace import Trace, combine_traces
+from tempo_tpu.resultcache import ResultCache, ResultCacheConfig
 from tempo_tpu.util import metrics, tracing
 
 log = logging.getLogger(__name__)
@@ -80,6 +81,8 @@ class DBConfig:
     # scan (the endpoint then computes on demand). Runs on compaction-
     # owning roles only — one fleet scanner per deployment is enough.
     analytics_scan_s: float = 600.0
+    # shard-partial result cache + negative cache (tempo_tpu/resultcache)
+    result_cache: ResultCacheConfig = field(default_factory=ResultCacheConfig)
 
 
 class TempoDB:
@@ -117,6 +120,11 @@ class TempoDB:
                 self._cache_client = cache_client
                 raw_backend = CachedBackend(raw_backend, cache_client)
         self.backend = TypedBackend(raw_backend)
+        # built even over an injected backend: the remote tier is simply
+        # absent then (local LRU only) — an injected store shares pages,
+        # not necessarily a cache client
+        self.result_cache = ResultCache(cfg.result_cache,
+                                        remote=self._cache_client)
         self.blocklist = Blocklist(quarantine_threshold=cfg.quarantine_threshold)
         self._orphan_seen: dict[tuple[str, str], float] = {}
         self._orphan_lock = threading.Lock()
@@ -725,6 +733,7 @@ class TempoDB:
         if self._poll_thread:
             self._poll_thread.join(timeout=5)
             self._poll_thread = None
+        self.result_cache.stop()
         if self._cache_client is not None:
             # drains write-behind queues and closes memcached sockets
             self._cache_client.stop()

@@ -20,6 +20,14 @@ from __future__ import annotations
 
 import re
 
+# Version of THIS normalizer's key space. The result cache prefixes
+# every key with it (rc{format}|qs{keyspace}|...), so any change to the
+# regexes or normalize_* functions below MUST bump it: a silent
+# normalizer change would otherwise map new queries onto old cache
+# entries and serve stale partials. tests/test_queryshape.py pins the
+# current value against a golden shape corpus.
+KEYSPACE_VERSION = 1
+
 # literals in TraceQL / tag expressions -> "?" so records group by shape
 _STR_RE = re.compile(r'"(?:[^"\\]|\\.)*"|`[^`]*`')
 _NUM_RE = re.compile(r"\b\d+(?:\.\d+)?(?:ns|us|ms|s|m|h)?\b")
@@ -52,3 +60,14 @@ def metrics_shape(query: str) -> str:
 def search_shape(req) -> str:
     """Cache key for a search request: kind-tagged normalized shape."""
     return "search|" + normalize_search(req)
+
+
+def query_literals(q: str) -> list[str]:
+    """The literals normalize_query strips, in source order — the shape
+    plus this list round-trips a query's identity, so the result cache
+    fingerprints (shape, literals) instead of raw text: two queries that
+    differ only in whitespace share an entry, two that differ in any
+    literal never do."""
+    out = list(_STR_RE.findall(q))
+    out.extend(_NUM_RE.findall(_STR_RE.sub('"?"', q)))
+    return out

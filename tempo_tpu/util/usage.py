@@ -86,6 +86,24 @@ _FIELD_FAMILIES = {
         "tempo_tpu_usage_transfer_bytes_total",
         "Bytes moved across the host<->device boundary (h2d + d2h) by "
         "device dispatches"),
+    "result_cache_hits": (
+        "tempo_tpu_usage_result_cache_hits_total",
+        "Shard-partial result-cache hits (cached partial served, block "
+        "fetch skipped)"),
+    "result_cache_misses": (
+        "tempo_tpu_usage_result_cache_misses_total",
+        "Shard-partial result-cache misses (block recomputed cold)"),
+    "result_cache_negative": (
+        "tempo_tpu_usage_result_cache_negative_total",
+        "Negative-cache vetoes served (block provably empty for the "
+        "query; fetch skipped entirely)"),
+    "result_cache_stores": (
+        "tempo_tpu_usage_result_cache_stores_total",
+        "Shard partials written into the result cache"),
+    "result_cache_bytes_saved": (
+        "tempo_tpu_usage_result_cache_bytes_saved_total",
+        "Backend bytes NOT read because a cached or negative entry "
+        "answered for the block"),
 }
 FIELDS = {field: help_ for field, (_, help_) in _FIELD_FAMILIES.items()}
 

@@ -50,6 +50,60 @@ class TestNormalizeSearch:
         assert queryshape.normalize_search(req) == '{ name = "?" }'
 
 
+class TestKeyspaceVersion:
+    """The result cache prefixes every key with qs{KEYSPACE_VERSION}:
+    a normalizer change that re-shapes ANY of the pinned fixtures above
+    without bumping the version would silently serve stale partials for
+    queries whose key no longer means what it meant. These tests turn
+    that contract into a failing diff."""
+
+    def test_version_pinned(self):
+        # bumping is legitimate (it rotates the result-cache keyspace);
+        # update this pin IN THE SAME COMMIT as the normalizer change
+        assert queryshape.KEYSPACE_VERSION == 1
+
+    def test_key_carries_version_prefix(self):
+        from tempo_tpu.resultcache import ResultCache
+
+        k = ResultCache.key("acme", "blk-1", "search", "fp")
+        assert f"|qs{queryshape.KEYSPACE_VERSION}|" in k
+        assert " " not in k and len(k) < 250  # memcached key rules
+
+    def test_literal_swap_same_shape_different_fingerprint(self):
+        # the property the split key encodes: shape normalization pools
+        # the PLAN (same compiled executable), while the fingerprint's
+        # ordered literals keep the RESULTS distinct
+        from tempo_tpu.resultcache import fingerprint
+
+        a = '{ resource.service.name = "cart" && duration > 250ms } | rate()'
+        b = '{ resource.service.name = "checkout" && duration > 9ms } | rate()'
+        assert queryshape.normalize_query(a) == queryshape.normalize_query(b)
+        fa = fingerprint(queryshape.metrics_shape(a), queryshape.query_literals(a))
+        fb = fingerprint(queryshape.metrics_shape(b), queryshape.query_literals(b))
+        assert fa != fb
+        # and the full identity is stable: same query -> same fingerprint
+        assert fa == fingerprint(queryshape.metrics_shape(a),
+                                 queryshape.query_literals(a))
+
+    def test_query_literals_ordered_and_complete(self):
+        q = '{ a = "x" && b = "y" && duration > 100ms }'
+        lits = queryshape.query_literals(q)
+        # string literals in text order, then numeric/duration literals
+        assert lits[:2] == ['"x"', '"y"']
+        assert any("100ms" in t for t in lits[2:])
+
+    def test_literal_order_distinguishes(self):
+        # swapped literal ASSIGNMENT must not collide: {a="x" && b="y"}
+        # and {a="y" && b="x"} share a shape and a literal SET
+        from tempo_tpu.resultcache import fingerprint
+
+        a = '{ a = "x" && b = "y" }'
+        b = '{ a = "y" && b = "x" }'
+        assert queryshape.query_literals(a) != queryshape.query_literals(b)
+        assert fingerprint(queryshape.query_literals(a)) != \
+            fingerprint(queryshape.query_literals(b))
+
+
 class TestSharedDefinition:
     def test_insights_reexports_queryshape(self):
         # agreement by construction, not by parallel implementation

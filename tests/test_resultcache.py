@@ -1,0 +1,601 @@
+"""Immutable-block result cache + negative cache (tempo_tpu/resultcache).
+
+The cache's whole contract is "cheaper, never different", so the suite
+is bit-identity plus economy plus safety:
+
+1. FRAME — entries are CRC-framed; any truncation/bit-flip/garbage
+   decodes to None (a damaged entry is a miss, never data).
+2. BIT-IDENTITY — for every cached partial kind (search, metrics,
+   graph, standing), cold (TEMPO_TPU_RESULT_CACHE=0) == first rc pass
+   (miss+store) == second rc pass (hit), at 1/2/4 shard counts with the
+   shard partials merged through the production merge seams.
+3. NEGATIVE — provably-empty blocks (zero rows inspected) cache vetoes;
+   repeats skip the block entirely and still agree with an unpruned
+   cold scan (zero incorrect vetoes); disabling negative caching stops
+   both writing AND serving vetoes.
+4. CHAOS — with TEMPO_TPU_FAULTS armed, corrupted/short-read cached
+   entries are detected by the frame, counted, and recomputed
+   bit-identically.
+5. ECONOMY/ACCOUNTING — hits zero the per-block cost stats, and every
+   hit/miss/negative/store/bytes-saved moves BOTH the untagged
+   kind-labelled counter and the per-tenant cost vector at the same
+   statement; the frontend's merged vector yields the insights verdict.
+6. OPS — LRU evictions are counted, a wedged memcached degrades to a
+   bounded-time miss (one reconnect, then give up), and check_config
+   warns about the no-backend and no-zonemaps footguns.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from tempo_tpu import resultcache as rc_mod
+from tempo_tpu.backend import MockBackend
+from tempo_tpu.cache.client import (
+    LRUCache,
+    MemcachedCache,
+    MockCache,
+    cache_evictions,
+)
+from tempo_tpu.config import check_config, parse_config
+from tempo_tpu.db import DBConfig, TempoDB
+from tempo_tpu.encoding.common import SearchRequest
+from tempo_tpu.metrics_engine import compile_metrics_plan, merge_wire, new_wire
+from tempo_tpu.model import synth
+from tempo_tpu.model import trace as tr
+from tempo_tpu.modules.querier import Querier
+from tempo_tpu.resultcache import (
+    ResultCache,
+    ResultCacheConfig,
+    decode_entry,
+    encode_entry,
+    fingerprint,
+)
+from tempo_tpu.util import usage
+
+BASE_S = 1_700_000_000
+
+
+def _mk_db(n_blocks=3, seed=700):
+    db = TempoDB(DBConfig(backend="mock"), raw_backend=MockBackend())
+    for i in range(n_blocks):
+        ts = synth.make_traces(40, seed=seed + i, spans_per_trace=4)
+        db.write_batch("t", tr.traces_to_batch(ts).sorted_by_trace())
+    return db, [m.block_id for m in db.blocklist.metas("t")]
+
+
+def _series(wire):
+    return json.dumps(wire["series"], sort_keys=True)
+
+
+def _traces(resp):
+    return [t.to_dict() for t in resp.traces]
+
+
+def _graph_content(wire):
+    return json.dumps({k: v for k, v in wire.items() if k != "stats"},
+                      sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# 1. frame
+# ---------------------------------------------------------------------------
+
+
+class TestFrame:
+    def test_roundtrip(self):
+        doc = {"w": {"series": [1, 2]}, "sb": 123}
+        assert decode_entry(encode_entry(doc)) == doc
+
+    def test_truncation_rejected(self):
+        raw = encode_entry({"w": [1, 2, 3], "sb": 0})
+        for cut in (1, 4, 8, len(raw) - 1):
+            assert decode_entry(raw[:cut]) is None
+
+    def test_every_single_bitflip_rejected(self):
+        raw = encode_entry({"w": "abc", "sb": 7})
+        for pos in range(len(raw)):
+            for bit in range(8):
+                bad = raw[:pos] + bytes([raw[pos] ^ (1 << bit)]) + raw[pos + 1:]
+                assert decode_entry(bad) is None, (pos, bit)
+
+    def test_garbage_rejected(self):
+        assert decode_entry(None) is None
+        assert decode_entry(b"") is None
+        assert decode_entry(b"not a frame at all") is None
+        # valid frame around a non-dict payload is still not an entry
+        import zlib
+        payload = b"[1,2]"
+        framed = b"RC1" + zlib.crc32(payload).to_bytes(4, "big") + payload
+        assert decode_entry(framed) is None
+
+    def test_fingerprint_stable_and_order_sensitive(self):
+        assert fingerprint("a", ["x"], 1) == fingerprint("a", ["x"], 1)
+        assert fingerprint("a", ["x", "y"]) != fingerprint("a", ["y", "x"])
+
+
+# ---------------------------------------------------------------------------
+# gating + accounting on a standalone instance
+# ---------------------------------------------------------------------------
+
+
+class TestGatingAndAccounting:
+    def test_kill_switch_states(self, monkeypatch):
+        rc = ResultCache(ResultCacheConfig(enabled=True))
+        monkeypatch.setenv("TEMPO_TPU_RESULT_CACHE", "0")
+        assert not rc.enabled()
+        monkeypatch.setenv("TEMPO_TPU_RESULT_CACHE", "force")
+        assert ResultCache(ResultCacheConfig(enabled=False)).enabled()
+        monkeypatch.delenv("TEMPO_TPU_RESULT_CACHE")
+        assert rc.enabled()
+        assert not ResultCache(ResultCacheConfig(enabled=False)).enabled()
+
+    def test_accounting_moves_counters_and_cost_vector(self):
+        rc = ResultCache(ResultCacheConfig(enabled=True))
+        fp = fingerprint("q")
+        with usage.collect() as vec:
+            assert rc.get("rc-acct", "b1", "search", fp) is None  # miss
+            rc.put("rc-acct", "b1", "search", fp, {"traces": []}, bytes_saved=100)
+            doc = rc.get("rc-acct", "b1", "search", fp)  # hit
+            assert doc["w"] == {"traces": []}
+            rc.put_negative("rc-acct", "b2", "search", fp, bytes_saved=40)
+            assert rc.get("rc-acct", "b2", "search", fp)["neg"] == 1
+        snap = vec.snapshot()
+        assert snap["result_cache_misses"] == 1
+        assert snap["result_cache_hits"] == 1
+        assert snap["result_cache_negative"] == 1
+        assert snap["result_cache_stores"] == 2
+        assert snap["result_cache_bytes_saved"] == 140
+
+    def test_negative_disabled_neither_writes_nor_serves(self):
+        rc = ResultCache(ResultCacheConfig(enabled=True, negative=True))
+        fp = fingerprint("q")
+        rc.put_negative("t", "b", "search", fp)
+        assert rc.get("t", "b", "search", fp)["neg"] == 1
+        # operator turns negative caching off: entries written earlier
+        # must stop being served (counted as a miss), new ones not written
+        rc.cfg.negative = False
+        with usage.collect() as vec:
+            assert rc.get("t", "b", "search", fp) is None
+            rc.put_negative("t", "b2", "search", fp)
+            assert rc.get("t", "b2", "search", fp) is None
+        assert vec.snapshot()["result_cache_misses"] == 2
+        assert "result_cache_stores" not in vec.snapshot()
+
+    def test_corrupt_local_entry_counts_and_misses(self):
+        rc = ResultCache(ResultCacheConfig(enabled=True))
+        fp = fingerprint("q")
+        rc.put("t", "b", "metrics", fp, {"x": 1})
+        k = rc.key("t", "b", "metrics", fp)
+        found, bufs, _ = rc._local.fetch([k])
+        assert found
+        bad = bufs[0][:-3] + bytes([bufs[0][-3] ^ 0x40]) + bufs[0][-2:]
+        rc._local.store([k], [bad])
+        before = rc_mod.rc_corrupt.value(kind="metrics")
+        assert rc.get("t", "b", "metrics", fp) is None
+        assert rc_mod.rc_corrupt.value(kind="metrics") == before + 1
+
+    def test_remote_tier_shared_and_promoted(self):
+        remote = MockCache()  # stands in for memcached/redis
+        a = ResultCache(ResultCacheConfig(enabled=True), remote=remote)
+        b = ResultCache(ResultCacheConfig(enabled=True), remote=remote)
+        fp = fingerprint("q")
+        a.put("t", "b1", "graph", fp, {"edges": []}, bytes_saved=9)
+        # a different replica hits via the remote tier...
+        doc = b.get("t", "b1", "graph", fp)
+        assert doc["w"] == {"edges": []}
+        # ...and promotes the entry into its local tier
+        k = b.key("t", "b1", "graph", fp)
+        found, _, _ = b._local.fetch([k])
+        assert found
+
+    def test_corrupt_remote_entry_not_promoted(self):
+        remote = MockCache()
+        rc = ResultCache(ResultCacheConfig(enabled=True), remote=remote)
+        fp = fingerprint("q")
+        k = rc.key("t", "b", "search", fp)
+        remote.store([k], [b"RC1garbage-that-fails-crc"])
+        assert rc.get("t", "b", "search", fp) is None
+        found, _, _ = rc._local.fetch([k])
+        assert not found  # a damaged entry must not be re-framed/laundered
+
+
+# ---------------------------------------------------------------------------
+# 2. bit-identity per kind, sharded
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    return _mk_db()
+
+
+class TestSearchBitIdentity:
+    @pytest.mark.parametrize("n_shards", [1, 2, 4])
+    def test_cold_miss_hit_identical(self, corpus, monkeypatch, n_shards):
+        db, ids = corpus
+        qr = Querier(db)
+        req = SearchRequest(tags={"service": "cart"}, limit=1000,
+                            start_seconds=BASE_S,
+                            end_seconds=BASE_S + 3600)
+
+        def run():
+            from tempo_tpu.encoding.common import SearchResponse
+            resp = SearchResponse()
+            for s in range(n_shards):
+                resp.merge(qr.search_block_batch("t", ids[s::n_shards], req),
+                           limit=req.limit)
+            return resp
+
+        monkeypatch.setenv("TEMPO_TPU_RESULT_CACHE", "0")
+        cold = run()
+        assert cold.traces
+        monkeypatch.setenv("TEMPO_TPU_RESULT_CACHE", "force")
+        db.result_cache.stop()  # per-param fresh cache
+        h0 = rc_mod.rc_hits.value(kind="search")
+        warm_miss = run()
+        warm_hit = run()
+        assert _traces(cold) == _traces(warm_miss) == _traces(warm_hit)
+        assert rc_mod.rc_hits.value(kind="search") >= h0 + len(ids)
+        # a fully-cached pass reads nothing from the backend
+        assert warm_hit.inspected_bytes == 0
+        assert warm_hit.inspected_blocks == 0
+
+    def test_incomplete_responses_not_cached(self, monkeypatch):
+        db, ids = _mk_db(n_blocks=1, seed=900)
+        qr = Querier(db)
+        monkeypatch.setenv("TEMPO_TPU_RESULT_CACHE", "force")
+        req = SearchRequest(tags={"service": "cart"}, limit=5,
+                            start_seconds=BASE_S, end_seconds=BASE_S + 3600)
+        sub = qr.search_block_job("t", ids[0], req)
+        sub.status = "partial"
+        monkeypatch.setattr(qr, "search_block_job",
+                            lambda *a, **k: sub)
+        s0 = rc_mod.rc_stores.value(kind="search")
+        qr.search_block_batch("t", ids, req)
+        assert rc_mod.rc_stores.value(kind="search") == s0
+
+
+class TestMetricsBitIdentity:
+    QUERIES = [
+        "{} | rate()",
+        "{ resource.service.name = `cart` } | rate()",
+        "{ duration > 100us } | count_over_time()",
+        "{} | rate() by (resource.service.name)",
+    ]
+
+    @pytest.mark.parametrize("n_shards", [1, 2, 4])
+    @pytest.mark.parametrize("q", QUERIES)
+    def test_cold_miss_hit_identical(self, corpus, monkeypatch, q, n_shards):
+        db, ids = corpus
+        qr = Querier(db)
+        plan = compile_metrics_plan(q, BASE_S, BASE_S + 60, 10)
+
+        def run():
+            merged = new_wire()
+            for s in range(n_shards):
+                w = qr.query_range_blocks("t", ids[s::n_shards], q,
+                                          BASE_S, BASE_S + 60, 10)
+                merge_wire(merged, w, plan)
+            return merged
+
+        monkeypatch.setenv("TEMPO_TPU_RESULT_CACHE", "0")
+        cold = run()
+        monkeypatch.setenv("TEMPO_TPU_RESULT_CACHE", "force")
+        db.result_cache.stop()
+        warm_miss = run()
+        warm_hit = run()
+        assert cold["series"] == warm_miss["series"] == warm_hit["series"]
+        assert cold["exemplars"] == warm_hit["exemplars"]
+
+    def test_hit_pass_inspects_nothing(self, monkeypatch):
+        db, ids = _mk_db(n_blocks=2, seed=760)
+        qr = Querier(db)
+        monkeypatch.setenv("TEMPO_TPU_RESULT_CACHE", "force")
+        q = "{} | rate()"
+        qr.query_range_blocks("t", ids, q, BASE_S, BASE_S + 60, 10)
+        w = qr.query_range_blocks("t", ids, q, BASE_S, BASE_S + 60, 10)
+        assert w["stats"]["inspectedBytes"] == 0
+        assert w["stats"]["inspectedBlocks"] == 0
+
+    def test_series_overflow_falls_through_to_cold(self, monkeypatch):
+        """A per-block table that dropped series CANNOT be merged
+        exactly — the cached tier must bail to the cold path, not
+        approximate."""
+        db, ids = _mk_db(n_blocks=2, seed=770)
+        qr = Querier(db)
+        monkeypatch.setenv("TEMPO_TPU_RESULT_CACHE", "force")
+        q = "{} | rate() by (resource.service.name)"
+        tight = qr.query_range_blocks("t", ids, q, BASE_S, BASE_S + 60, 10,
+                                      max_series=1)
+        monkeypatch.setenv("TEMPO_TPU_RESULT_CACHE", "0")
+        cold = qr.query_range_blocks("t", ids, q, BASE_S, BASE_S + 60, 10,
+                                     max_series=1)
+        assert _series(tight) == _series(cold)
+
+
+class TestGraphBitIdentity:
+    @pytest.mark.parametrize("want", ["deps", "cp"])
+    def test_cold_miss_hit_identical(self, corpus, monkeypatch, want):
+        db, ids = corpus
+        qr = Querier(db)
+
+        def run():
+            return qr.graph_blocks("t", ids, "", BASE_S, BASE_S + 3600, want)
+
+        monkeypatch.setenv("TEMPO_TPU_RESULT_CACHE", "0")
+        cold = run()
+        monkeypatch.setenv("TEMPO_TPU_RESULT_CACHE", "force")
+        db.result_cache.stop()
+        h0 = rc_mod.rc_hits.value(kind="graph")
+        warm_miss = run()
+        warm_hit = run()
+        assert _graph_content(cold) == _graph_content(warm_miss) \
+            == _graph_content(warm_hit)
+        assert rc_mod.rc_hits.value(kind="graph") == h0 + len(ids)
+        assert warm_hit["stats"]["inspectedBytes"] == 0
+
+
+class TestStandingBitIdentity:
+    def test_rebuild_replays_cached_rows_identically(self, tmp_path,
+                                                     monkeypatch):
+        from tempo_tpu.app import App, AppConfig
+
+        def vals(mat):
+            return sorted(
+                (tuple(sorted(r["metric"].items())),
+                 tuple(map(tuple, r["values"])))
+                for r in mat["result"])
+
+        base = (int(time.time()) // 60) * 60 - 600
+        body = {"q": "{} | rate() by (resource.service.name)",
+                "step": 60, "window": 3600}
+        monkeypatch.setenv("TEMPO_TPU_RESULT_CACHE", "0")
+        app = App(AppConfig(
+            db=DBConfig(backend="local", backend_path=str(tmp_path / "blocks"),
+                        wal_path=str(tmp_path / "wal")),
+            generator_enabled=False))
+        try:
+            app.push_traces(synth.make_traces(
+                10, seed=5, spans_per_trace=4, base_time_ns=base * 10**9))
+            for ing in app.ingesters.values():
+                for inst in list(ing.instances.values()):
+                    inst.cut_complete_traces(immediate=True)
+                    inst.cut_block_if_ready(immediate=True)
+                    inst.complete_and_flush()
+            app.db.poll_now()
+            # cold reference: registration backfill with the cache off
+            doc = app.standing_register(body)
+            cold = vals(app.standing_read(doc["id"], start_s=base - 60,
+                                          end_s=base + 120))
+            assert cold
+            app.standing_delete(doc["id"])
+            monkeypatch.setenv("TEMPO_TPU_RESULT_CACHE", "force")
+            # first rebuild logs + stores, second replays from cache
+            doc = app.standing_register(body)
+            miss = vals(app.standing_read(doc["id"], start_s=base - 60,
+                                          end_s=base + 120))
+            app.standing_delete(doc["id"])
+            h0 = rc_mod.rc_hits.value(kind="standing")
+            doc = app.standing_register(body)
+            hit = vals(app.standing_read(doc["id"], start_s=base - 60,
+                                         end_s=base + 120))
+            assert cold == miss == hit
+            assert rc_mod.rc_hits.value(kind="standing") > h0
+        finally:
+            app.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 3. negative cache
+# ---------------------------------------------------------------------------
+
+
+class TestNegativeCache:
+    def test_vetoes_agree_with_unpruned_cold_scan(self, corpus, monkeypatch):
+        db, ids = corpus
+        qr = Querier(db)
+        req = SearchRequest(tags={"service": "no-such-svc"}, limit=100,
+                            start_seconds=BASE_S, end_seconds=BASE_S + 3600)
+        # the ground truth: a cold scan with zone-map pruning DISABLED
+        # (every row group actually read) finds nothing
+        monkeypatch.setenv("TEMPO_TPU_RESULT_CACHE", "0")
+        monkeypatch.setenv("TEMPO_TPU_ZONEMAPS", "0")
+        unpruned = qr.search_block_batch("t", ids, req)
+        assert not unpruned.traces
+        monkeypatch.delenv("TEMPO_TPU_ZONEMAPS")
+        monkeypatch.setenv("TEMPO_TPU_RESULT_CACHE", "force")
+        db.result_cache.stop()
+        n0 = rc_mod.rc_negative.value(kind="search")
+        first = qr.search_block_batch("t", ids, req)   # stores vetoes
+        second = qr.search_block_batch("t", ids, req)  # serves vetoes
+        assert not first.traces and not second.traces
+        assert rc_mod.rc_negative.value(kind="search") == n0 + len(ids)
+        # a veto skips the block entirely — not even a meta fetch
+        assert second.inspected_blocks == 0
+        assert second.inspected_bytes == 0
+
+    def test_metrics_veto_only_on_zero_inspection(self, monkeypatch):
+        db, ids = _mk_db(n_blocks=2, seed=780)
+        qr = Querier(db)
+        monkeypatch.setenv("TEMPO_TPU_RESULT_CACHE", "force")
+        q = "{ resource.service.name = `no-such-svc` } | rate()"
+        n0 = rc_mod.rc_negative.value(kind="metrics")
+        w0 = qr.query_range_blocks("t", ids, q, BASE_S, BASE_S + 60, 10)
+        assert w0["stats"]["inspectedSpans"] == 0  # provably empty
+        w1 = qr.query_range_blocks("t", ids, q, BASE_S, BASE_S + 60, 10)
+        assert w0["series"] == w1["series"] == []
+        assert rc_mod.rc_negative.value(kind="metrics") == n0 + len(ids)
+        # a matching query that RETURNS nothing in the window but DID
+        # inspect spans must cache a regular entry, not a veto
+        q2 = "{ resource.service.name = `cart` } | rate()"
+        n1 = rc_mod.rc_negative.value(kind="metrics")
+        qr.query_range_blocks("t", ids, q2, BASE_S, BASE_S + 60, 10)
+        qr.query_range_blocks("t", ids, q2, BASE_S, BASE_S + 60, 10)
+        assert rc_mod.rc_negative.value(kind="metrics") == n1
+
+
+# ---------------------------------------------------------------------------
+# 4. chaos: the frame under an armed fault plan
+# ---------------------------------------------------------------------------
+
+
+class TestChaos:
+    @pytest.mark.parametrize("spec", ["corrupt=1.0,seed=7",
+                                      "short=1.0,seed=11"])
+    def test_damaged_entries_recompute_bit_identically(self, monkeypatch,
+                                                       spec):
+        db, ids = _mk_db(n_blocks=2, seed=810)
+        qr = Querier(db)
+        q = "{} | rate()"
+        monkeypatch.setenv("TEMPO_TPU_RESULT_CACHE", "0")
+        cold = qr.query_range_blocks("t", ids, q, BASE_S, BASE_S + 60, 10)
+        monkeypatch.setenv("TEMPO_TPU_RESULT_CACHE", "force")
+        qr.query_range_blocks("t", ids, q, BASE_S, BASE_S + 60, 10)  # store
+        # arm faults AFTER the db was built: the mock backend stays
+        # clean, only the result-cache fetch seam injects
+        monkeypatch.setenv("TEMPO_TPU_FAULTS", spec)
+        c0 = rc_mod.rc_corrupt.value(kind="metrics")
+        damaged = qr.query_range_blocks("t", ids, q, BASE_S, BASE_S + 60, 10)
+        assert _series(damaged) == _series(cold)
+        # every fetched entry was damaged -> detected -> recomputed
+        assert rc_mod.rc_corrupt.value(kind="metrics") >= c0 + len(ids)
+        # detection also means the damaged pass did real work again
+        assert damaged["stats"]["inspectedBytes"] > 0
+
+    def test_search_chaos_recomputes(self, monkeypatch):
+        db, ids = _mk_db(n_blocks=2, seed=820)
+        qr = Querier(db)
+        req = SearchRequest(tags={"service": "cart"}, limit=100,
+                            start_seconds=BASE_S, end_seconds=BASE_S + 3600)
+        monkeypatch.setenv("TEMPO_TPU_RESULT_CACHE", "force")
+        first = qr.search_block_batch("t", ids, req)
+        monkeypatch.setenv("TEMPO_TPU_FAULTS", "corrupt=1.0,seed=3")
+        damaged = qr.search_block_batch("t", ids, req)
+        assert _traces(damaged) == _traces(first)
+        assert damaged.inspected_bytes > 0
+
+
+# ---------------------------------------------------------------------------
+# 5. insights verdict
+# ---------------------------------------------------------------------------
+
+
+class TestInsightsVerdict:
+    @pytest.mark.parametrize("fields,verdict", [
+        ({"result_cache_hits": 3}, "hit"),
+        ({"result_cache_misses": 1, "result_cache_stores": 1}, "store"),
+        ({"result_cache_misses": 1}, "miss"),
+        ({"result_cache_hits": 2, "result_cache_misses": 1,
+          "result_cache_stores": 1}, "store"),
+        ({"result_cache_negative": 4}, "negative"),
+        ({"result_cache_hits": 1, "result_cache_negative": 2}, "hit"),
+        ({"inspected_bytes": 10}, None),
+    ])
+    def test_merged_usage_yields_verdict(self, fields, verdict):
+        from tempo_tpu.modules.frontend import Frontend
+        from tempo_tpu.util import insights
+
+        with insights.LOG.observe("t", "search", "{}") as rec:
+            with usage.collect():
+                Frontend._merge_stage_wires([{"usage": fields}])
+            assert rec.get("resultCache") == verdict
+
+
+# ---------------------------------------------------------------------------
+# 6. ops: eviction counter, wedged memcached, check_config
+# ---------------------------------------------------------------------------
+
+
+class TestOps:
+    def test_lru_eviction_counter(self):
+        c = LRUCache(max_bytes=100)
+        before = cache_evictions.value()
+        c.store(["a", "b"], [b"x" * 60, b"y" * 60])  # evicts "a"
+        assert cache_evictions.value() == before + 1
+        found, _, _ = c.fetch(["a", "b"])
+        assert found == ["b"]
+
+    def test_wedged_memcached_degrades_to_miss(self):
+        """A server that accepts and never answers must cost at most
+        ~2x the socket timeout (one reconnect, then give up) and read
+        as a miss — never a wedged querier."""
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)
+        conns = []
+
+        def accept_and_hang():
+            try:
+                while True:
+                    conn, _ = srv.accept()
+                    conns.append(conn)  # keep open, never respond
+            except OSError:
+                pass
+
+        t = threading.Thread(target=accept_and_hang, daemon=True)
+        t.start()
+        try:
+            addr = "127.0.0.1:%d" % srv.getsockname()[1]
+            mc = MemcachedCache([addr], timeout_s=0.15)
+            start = time.monotonic()
+            found, bufs, missed = mc.fetch(["k1"])
+            elapsed = time.monotonic() - start
+            assert found == [] and missed == ["k1"]
+            assert elapsed < 1.5  # 2 attempts * timeout, with slack
+            mc.store(["k1"], [b"v"])  # must not raise either
+            mc.stop()
+        finally:
+            srv.close()
+            for conn in conns:
+                conn.close()
+
+    def test_check_config_warns_no_cache_backend(self):
+        cfg = parse_config(
+            "storage:\n"
+            "  trace:\n"
+            "    backend: mock\n"
+            "    cache: none\n"
+            "    result_cache:\n"
+            "      enabled: true\n")
+        assert any("result_cache" in w and "cache: none" in w
+                   for w in check_config(cfg))
+
+    def test_check_config_warns_negative_without_zonemaps(self, monkeypatch):
+        monkeypatch.setenv("TEMPO_TPU_ZONEMAPS", "0")
+        cfg = parse_config(
+            "storage:\n"
+            "  trace:\n"
+            "    backend: mock\n"
+            "    cache: memory\n"
+            "    result_cache:\n"
+            "      enabled: true\n")
+        assert any("TEMPO_TPU_ZONEMAPS" in w for w in check_config(cfg))
+
+    def test_check_config_quiet_when_disabled(self, monkeypatch):
+        monkeypatch.setenv("TEMPO_TPU_ZONEMAPS", "0")
+        cfg = parse_config(
+            "storage:\n  trace:\n    backend: mock\n    cache: none\n")
+        assert not any("result_cache" in w for w in check_config(cfg))
+
+    def test_usage_settles_under_tenant_and_kind(self, monkeypatch):
+        db, ids = _mk_db(n_blocks=2, seed=830)
+        qr = Querier(db)
+        monkeypatch.setenv("TEMPO_TPU_RESULT_CACHE", "force")
+        req = SearchRequest(tags={"service": "cart"}, limit=100,
+                            start_seconds=BASE_S, end_seconds=BASE_S + 3600)
+        usage.ACCOUNTANT.reset()
+        with usage.attribute("rc-acct", "search"):
+            qr.search_block_batch("t", ids, req)
+        with usage.attribute("rc-acct", "search"):
+            qr.search_block_batch("t", ids, req)
+        row = usage.ACCOUNTANT.snapshot("rc-acct")["rc-acct"]["search"]
+        assert row["result_cache_misses"] == len(ids)
+        assert row["result_cache_stores"] == len(ids)
+        assert row["result_cache_hits"] == len(ids)
+        assert row["result_cache_bytes_saved"] > 0

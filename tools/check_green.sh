@@ -47,11 +47,35 @@ if [ "$rc" -eq 0 ]; then
   fi
 fi
 
+# Result-cache smoke (ISSUE 19): its own cluster with the cache forced
+# on fleet-wide — it must NOT share the compiled-shapes cluster, because
+# the cached metrics path answers before the compiled tier and would
+# starve that arm's gates. The repeat arm fires one frozen search +
+# query_range + provably-empty search cold, then 5 warm repeats, gated
+# on bit-identical responses, hits climbing with misses flat, per-iter
+# inspected bytes collapsing, and zero incorrect negative vetoes.
+rcache_rc=0
+if [ "$rc" -eq 0 ]; then
+  timeout -k 10 420 python tools/loadtest.py --duration 5 --rate 1 \
+    --skip-sweep --slo-scale 8 --rss-growth-limit 3.0 --repeat 5 \
+    >/tmp/_t1_rcache.json 2>/tmp/_t1_rcache.log
+  rcache_rc=$?
+  if [ "$rcache_rc" -ne 0 ]; then
+    echo "check_green: result-cache smoke RED (exit $rcache_rc)" >&2
+    tail -5 /tmp/_t1_rcache.log >&2
+  else
+    echo "check_green: result-cache smoke green" >&2
+  fi
+fi
+
 if [ "$rc" -ne 0 ]; then
   echo "check_green: RED (pytest exit $rc)" >&2
 elif [ "$hot_rc" -ne 0 ]; then
   echo "check_green: RED (hot/compiled-tier smoke exit $hot_rc)" >&2
   rc=$hot_rc
+elif [ "$rcache_rc" -ne 0 ]; then
+  echo "check_green: RED (result-cache smoke exit $rcache_rc)" >&2
+  rc=$rcache_rc
 else
   echo "check_green: green" >&2
 fi

@@ -1185,6 +1185,162 @@ def compiled_shapes_probe(query_url: str, scrape_urls: list,
 
 
 # ---------------------------------------------------------------------------
+# --repeat arm: repeated identical queries against the result cache
+# (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+def _scrape_resultcache(urls: list) -> dict:
+    """Sum the result-cache gate's families across processes."""
+    out = {"hits": 0.0, "misses": 0.0, "negative": 0.0, "stores": 0.0,
+           "bytes_saved": 0.0, "inspected_bytes": 0.0}
+    for _name, url in urls:
+        try:
+            with urllib.request.urlopen(url + "/metrics", timeout=15) as r:
+                met = r.read().decode()
+        except Exception:  # noqa: BLE001 — a dead proc fails the gates anyway
+            continue
+        for line in met.splitlines():
+            try:
+                val = float(line.rsplit(" ", 1)[1])
+            except (ValueError, IndexError):
+                continue
+            if line.startswith("tempo_tpu_resultcache_hits_total"):
+                out["hits"] += val
+            elif line.startswith("tempo_tpu_resultcache_misses_total"):
+                out["misses"] += val
+            elif line.startswith("tempo_tpu_resultcache_negative_total"):
+                out["negative"] += val
+            elif line.startswith("tempo_tpu_resultcache_stores_total"):
+                out["stores"] += val
+            elif line.startswith("tempo_tpu_resultcache_bytes_saved_total"):
+                out["bytes_saved"] += val
+            elif line.startswith("tempo_tpu_usage_inspected_bytes_total"):
+                out["inspected_bytes"] += val
+    return out
+
+
+def repeat_probe(query_url: str, scrape_urls: list, iters: int = 5) -> dict:
+    """Repeated-query arm against the result cache: freeze one search
+    and one query_range at the synth epoch (identical block set every
+    pass) plus one provably-empty search (a service that never existed
+    — the negative-cache probe), fire each once cold, then `iters` warm
+    repeats. Gates:
+
+    - every warm response BIT-IDENTICAL to the cold one (content
+      compared, not cost stats — those are SUPPOSED to collapse),
+    - cache hits climbing while misses stay ~flat (every immutable
+      block answers from cache; the blocklist is stable post-drain),
+    - per-iter inspected bytes collapsing vs the cold pass and
+      bytes-saved climbing (the economy claim, from the counters the
+      dashboards read),
+    - the negative probe returns ZERO traces on every pass INCLUDING
+      the cold unpruned one, while the negative counter climbs — a
+      veto is only ever a recomputation skip, never a wrong answer,
+    - a deliberately lenient latency backstop (CI wall clocks are
+      noisy; inspected-bytes is the deterministic signal).
+    """
+    from tempo_tpu.model import synth
+
+    base_s = 1_700_000_000  # synth traces are pinned at a fixed epoch
+    svc = None
+    for cand in synth.SERVICES:
+        qs = urllib.parse.urlencode({
+            "tags": f"service.name={cand}",
+            "start": base_s - 300, "end": base_s + 300, "limit": 50})
+        try:
+            doc = _get_json(f"{query_url}/api/search?{qs}", timeout=30)
+        except Exception:  # noqa: BLE001
+            continue
+        if doc.get("traces"):
+            svc = cand
+            break
+    if svc is None:
+        return {"error": "no service with searchable traces", "passed": False}
+
+    search_qs = urllib.parse.urlencode({
+        "tags": f"service.name={svc}",
+        "start": base_s - 300, "end": base_s + 300, "limit": 50})
+    range_qs = urllib.parse.urlencode({
+        "q": "{ resource.service.name = `%s` } | rate()" % svc,
+        "start": base_s - 300, "end": base_s + 300, "step": 10})
+    neg_qs = urllib.parse.urlencode({
+        "tags": "service.name=no-such-svc-rc-probe",
+        "start": base_s - 300, "end": base_s + 300, "limit": 50})
+
+    def canon_search(doc):
+        return json.dumps(sorted(
+            (t.get("traceID"), t.get("startTimeUnixNano"))
+            for t in doc.get("traces") or []))
+
+    def canon_range(doc):
+        return json.dumps((doc or {}).get("data"), sort_keys=True)
+
+    def fire():
+        t0 = time.monotonic()
+        try:
+            s = _get_json(f"{query_url}/api/search?{search_qs}", timeout=30)
+            m = _get_json(f"{query_url}/api/metrics/query_range?{range_qs}",
+                          timeout=30)
+            n = _get_json(f"{query_url}/api/search?{neg_qs}", timeout=30)
+        except Exception:  # noqa: BLE001 — a failed pass breaks identity
+            return None, None, None, time.monotonic() - t0
+        return (canon_search(s), canon_range(m),
+                len(n.get("traces") or []), time.monotonic() - t0)
+
+    base = _scrape_resultcache(scrape_urls)
+    cold_search, cold_range, cold_neg, cold_t = fire()
+    mid = _scrape_resultcache(scrape_urls)
+    identical, neg_always_empty, warm_ts = True, cold_neg == 0, []
+    for _ in range(iters):
+        w_search, w_range, w_neg, dt = fire()
+        warm_ts.append(dt)
+        identical = identical and (w_search == cold_search
+                                   and w_range == cold_range)
+        neg_always_empty = neg_always_empty and w_neg == 0
+    after = _scrape_resultcache(scrape_urls)
+
+    cold = {k: mid[k] - base[k] for k in mid}
+    warm = {k: after[k] - mid[k] for k in after}
+    warm_p50 = sorted(warm_ts)[len(warm_ts) // 2] if warm_ts else 0.0
+    cold_touched = cold["misses"] > 0  # the cold pass reached real blocks
+    hits_climb = warm["hits"] >= iters
+    # a stray miss = a block that appeared mid-probe (compaction); the
+    # steady state is zero, the allowance keeps the gate honest not flaky
+    misses_flat = warm["misses"] <= max(1.0, 0.1 * warm["hits"])
+    negative_climb = warm["negative"] >= iters
+    saved_climb = warm["bytes_saved"] > 0
+    # warm per-iter read bytes must collapse vs the cold pass; the
+    # allowance covers live-segment scans the block cache cannot absorb
+    bytes_collapse = (warm["inspected_bytes"] / max(iters, 1)
+                      <= 0.6 * cold["inspected_bytes"])
+    latency_ok = warm_p50 <= cold_t * 2.0 + 0.25
+    return {
+        "service": svc,
+        "iters": iters,
+        "cold": cold,
+        "warm": warm,
+        "cold_s": round(cold_t, 4),
+        "warm_p50_s": round(warm_p50, 4),
+        "gates": {
+            "cold_touched_blocks": cold_touched,
+            "responses_identical": identical,
+            "hits_climb": hits_climb,
+            "misses_flat": misses_flat,
+            "negative_climb": negative_climb,
+            "negative_zero_results": neg_always_empty,
+            "bytes_saved_climb": saved_climb,
+            "inspected_bytes_collapse": bytes_collapse,
+            "latency_backstop": latency_ok,
+        },
+        "passed": bool(cold_touched and identical and hits_climb
+                       and misses_flat and negative_climb
+                       and neg_always_empty and saved_climb
+                       and bytes_collapse and latency_ok),
+    }
+
+
+# ---------------------------------------------------------------------------
 # --ingest-heavy arm: write-dominated burst against the device-native
 # ingest plane (ISSUE 18)
 # ---------------------------------------------------------------------------
@@ -1582,6 +1738,18 @@ def main() -> int:
                          "windows, gated on zero program retraces across "
                          "the rotation, shape-cache hits climbing, and "
                          "the fused path actually dispatching")
+    ap.add_argument("--repeat", type=int, default=0, metavar="N",
+                    help="enable the result cache fleet-wide "
+                         "(TEMPO_TPU_RESULT_CACHE=force) and run a "
+                         "repeated-query arm after the drain: one frozen "
+                         "search + query_range + provably-empty search "
+                         "fired cold then N warm repeats, gated on "
+                         "bit-identical responses, cache hits climbing "
+                         "with misses flat, per-iter inspected bytes "
+                         "collapsing, and zero incorrect negative vetoes. "
+                         "Incompatible with --shapes on the same cluster: "
+                         "the cached metrics path answers before the "
+                         "compiled tier, so its gates would starve")
     ap.add_argument("--ingest-heavy", action="store_true",
                     help="enable the device-native ingest plane fleet-wide "
                          "(device encode armed, ingest-tail residency on) "
@@ -1600,6 +1768,10 @@ def main() -> int:
                          "IDs, and the run gates on attribution exactness "
                          "(per-tenant cost vectors == untagged counters)")
     args = ap.parse_args()
+    if args.repeat > 0 and args.shapes > 0:
+        ap.error("--repeat and --shapes cannot share a cluster: the result "
+                 "cache answers metrics queries before the compiled tier, "
+                 "so the compiled-shapes gates would never fire")
     multitenant = args.tenants > 1
     tenant_ids = [f"lt-tenant-{i}" for i in range(args.tenants)] if multitenant else None
 
@@ -1621,8 +1793,14 @@ def main() -> int:
             # tier, plus the ingest_tail share), so both arms share it
             extra = (INGEST_TAIL_EXTRA if args.ingest_heavy
                      else HOT_TIER_EXTRA if args.hot > 0 else "")
-            env_extra = ({"TEMPO_TPU_DEVICE_ENCODE": "1"}
-                         if args.ingest_heavy else None)
+            env_extra = {}
+            if args.ingest_heavy:
+                env_extra["TEMPO_TPU_DEVICE_ENCODE"] = "1"
+            if args.repeat > 0:
+                # result_cache lives under storage.trace; the env force
+                # switch enables it fleet-wide without touching `extra`
+                env_extra["TEMPO_TPU_RESULT_CACHE"] = "force"
+            env_extra = env_extra or None
             procs, front, dist = start_cluster(
                 tmpdir, grpc_port=grpc_port, multitenant=multitenant,
                 extra=extra, env_extra=env_extra)
@@ -1739,6 +1917,13 @@ def main() -> int:
             shapes_ok = summary["compiled_shapes"]["passed"]
             print(f"[loadtest] compiled-shapes gate: "
                   f"{summary['compiled_shapes']}", file=sys.stderr)
+        repeat_ok = True
+        if args.repeat > 0:
+            summary["result_cache"] = repeat_probe(
+                query_url, check_urls, iters=args.repeat)
+            repeat_ok = summary["result_cache"]["passed"]
+            print(f"[loadtest] result-cache gate: {summary['result_cache']}",
+                  file=sys.stderr)
         summary["passed"] = bool(
             summary["slo_pass"]
             and loss["passed"]
@@ -1750,6 +1935,7 @@ def main() -> int:
             and hot_ok
             and ingest_ok
             and shapes_ok
+            and repeat_ok
             and (rss is None or summary["rss"]["passed"])
         )
         print(json.dumps(summary))
