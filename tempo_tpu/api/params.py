@@ -29,6 +29,7 @@ PATH_GRAPH_DEPENDENCIES = "/api/graph/dependencies"
 PATH_GRAPH_CRITICAL_PATH = "/api/graph/critical-path"
 PATH_GRAPH_WALKS = "/api/graph/walks"
 PATH_QUERY_INSIGHTS = "/api/query-insights"  # tenant-scoped query records
+PATH_RCA = "/api/rca"  # + /{incidentID} — auto-RCA incident records
 PATH_ECHO = "/api/echo"
 
 _DUR_RE = re.compile(r"([0-9]*\.?[0-9]+)(ns|us|µs|ms|s|m|h)")

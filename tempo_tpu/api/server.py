@@ -174,6 +174,8 @@ class _Handler(BaseHTTPRequestHandler):
             if p.endswith("/state"):
                 return api_params.PATH_METRICS_STANDING + "/{id}/state"
             return api_params.PATH_METRICS_STANDING + "/{id}"
+        if p.startswith(api_params.PATH_RCA + "/"):
+            return api_params.PATH_RCA + "/{incidentID}"
         if p.startswith(api_params.PATH_SEARCH_TAG_VALUES + "/") and p.endswith("/values"):
             return api_params.PATH_SEARCH_TAG_VALUES + "/{name}/values"
         if p.startswith("/rpc/v1/worker/result/"):
@@ -414,6 +416,9 @@ class _Handler(BaseHTTPRequestHandler):
                 "compiled": compiled_cache.shape_cache().stats(),
             })
             return 200
+        if path == api_params.PATH_RCA or path.startswith(
+                api_params.PATH_RCA + "/"):
+            return self._rca(path)
         if path == api_params.PATH_ECHO:
             self._send(200, b"echo", "text/plain; charset=utf-8")
             return 200
@@ -602,6 +607,15 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._send_json(200, {"enabled": True, **eng.status()})
             return 200
+        if path == "/status/rca":
+            # auto-RCA engine rollup: incidents held, suppressed count,
+            # pending trigger queue depth
+            eng = getattr(app, "rca", None)
+            if eng is None:
+                self._send_json(200, {"enabled": False})
+            else:
+                self._send_json(200, {"enabled": True, **eng.status()})
+            return 200
         if path == "/status/slo":
             # the burn-rate SLO engine's accounting document (util/slo):
             # per objective, the cumulative good/total the SLIs derive
@@ -717,6 +731,35 @@ class _Handler(BaseHTTPRequestHandler):
             return 405
         except UnknownStandingQuery:
             self._send_error(404, "no such standing query")
+            return 404
+
+    # -- auto-RCA incidents --------------------------------------------
+    def _rca(self, path: str) -> int:
+        """GET /api/rca (newest-first summaries) and
+        GET /api/rca/{incidentID} (the full finding + evidence bundle).
+        Tenant-scoped: a tenant sees its own incidents plus global
+        (process-level SLO) ones, and a foreign tenant's incident id is
+        indistinguishable from absent."""
+        from tempo_tpu.rca import UnknownIncident
+
+        app, org = self.app, self._org_id()
+        tail = path[len(api_params.PATH_RCA):].strip("/")
+        if not tail:
+            eng = getattr(app, "rca", None)
+            if eng is None:
+                self._send_json(200, {"enabled": False, "incidents": []})
+                return 200
+            self._send_json(200, {"enabled": True,
+                                  "incidents": app.rca_list(org_id=org)})
+            return 200
+        if "/" in tail:
+            self._send_error(404, "not found")
+            return 404
+        try:
+            self._send_json(200, app.rca_get(tail, org_id=org))
+            return 200
+        except UnknownIncident:
+            self._send_error(404, "no such incident")
             return 404
 
     # -- query handlers ------------------------------------------------
@@ -876,6 +919,8 @@ _ENDPOINTS = [
     "GET /api/graph/walks",
     "GET /api/usage",
     "GET /api/query-insights",
+    "GET /api/rca",
+    "GET /api/rca/{incidentID}",
     "GET /api/echo",
     "GET /ready",
     "GET /metrics",
@@ -889,6 +934,7 @@ _ENDPOINTS = [
     "GET /status/device",
     "GET /status/usage",
     "GET /status/usage-stats",
+    "GET /status/rca",
     "GET /status/slo",
     "GET /status/standing",
     "GET /status/storage",

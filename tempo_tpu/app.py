@@ -36,6 +36,7 @@ from tempo_tpu.modules.rpc import (
     RPCHandler,
 )
 from tempo_tpu.modules.worker import JobBroker, LocalWorkerPool, RemoteWorker
+from tempo_tpu.rca import RCAConfig, RCAEngine
 from tempo_tpu.util import devicetiming  # noqa: F401 — registers the
 # device-dispatch histograms so /metrics exposes them from boot, not
 # from the first dispatch
@@ -123,6 +124,10 @@ class AppConfig:
     # programs for simple-count metrics plans; kill switch
     # TEMPO_TPU_COMPILED=0 or compiled.enabled=false
     compiled: "CompiledConfig" = field(default_factory=CompiledConfig)
+    # auto-RCA incident engine (tempo_tpu/rca): SLO fast-burn and
+    # standing-deviation triggers open machine-written incident records
+    # with a typed, evidence-backed root cause
+    rca: "RCAConfig" = field(default_factory=RCAConfig)
 
 
 class RoleUnavailable(RuntimeError):
@@ -202,6 +207,22 @@ class App:
         self._maybe_vulture()
         if cfg.slo.enabled:
             self.slo_engine = slo.SLOEngine(cfg.slo)
+        self.rca = None
+        self._maybe_rca()
+
+    def _maybe_rca(self):
+        """Auto-RCA incident engine: subscribes to the SLO evaluator's
+        page-burn transitions and the standing engine's deviation fires.
+        Evidence collection runs queries, so it needs a frontend — the
+        all-in-one target is the natural host; other roles get the
+        triggers they can serve evidence for."""
+        if not self.cfg.rca.enabled:
+            return
+        self.rca = RCAEngine(self.cfg.rca, self)
+        if self.slo_engine is not None:
+            self.slo_engine.subscribe(self.rca.on_slo_burn)
+        if self.standing is not None:
+            self.standing.subscribe_deviations(self.rca.on_deviation)
 
     # ------------------------------------------------------------------
     def _hb_period(self) -> float:
@@ -644,6 +665,7 @@ class App:
             window_s=int(body.get("window", 0)),
             alert=body.get("alert"),
             max_series=int(body.get("maxSeries", 64)),
+            deviation=body.get("deviation"),
         )
         return q.to_doc()
 
@@ -661,6 +683,19 @@ class App:
 
     def standing_delete(self, qid: str, org_id=None) -> None:
         self._standing().delete(self.resolve_tenant(org_id), qid)
+
+    # -- auto-RCA incidents -----------------------------------------------
+    def rca_list(self, org_id=None) -> list[dict]:
+        """GET /api/rca: newest-first incident summaries — the tenant's
+        own plus global (process-level SLO) incidents."""
+        return self._require(self.rca, "rca incidents").list(
+            self.resolve_tenant(org_id))
+
+    def rca_get(self, incident_id: str, org_id=None) -> dict:
+        """GET /api/rca/{incidentID}: the full incident record (finding
+        + evidence bundle)."""
+        return self._require(self.rca, "rca incidents").get(
+            incident_id, self.resolve_tenant(org_id))
 
     def search_tags(self, org_id=None) -> list[str]:
         """Reference: /api/search/tags is proxied by the frontend straight
@@ -694,6 +729,8 @@ class App:
             self.vulture.start()
         if self.slo_engine is not None:
             self.slo_engine.start()
+        if self.rca is not None:
+            self.rca.start()
 
     def sweep_all(self, immediate: bool = False):
         """Deterministic maintenance for tests/drives."""
@@ -703,7 +740,8 @@ class App:
     def service_states(self) -> dict:
         states = {"target": self.target}
         for name in ("distributor", "querier", "frontend", "compactor",
-                     "generator", "vulture", "slo_engine", "standing"):
+                     "generator", "vulture", "slo_engine", "standing",
+                     "rca"):
             if getattr(self, name) is not None:
                 states[name] = "Running"
         for iid in self.ingesters:
@@ -721,6 +759,10 @@ class App:
         if self._self_export_client is not None:
             self._self_export_client.close()
             self._self_export_client = None
+        # the RCA worker goes down FIRST: its evidence collection runs
+        # queries against the app being dismantled
+        if self.rca is not None:
+            self.rca.stop()
         # the prober and SLO engine go down BEFORE the rings/KVs: a
         # check racing the half-dismantled app would record phantom
         # data-loss errors into the very counters alerting watches

@@ -647,6 +647,51 @@ def cmd_convert(args) -> int:
     return 0
 
 
+# -- rca -------------------------------------------------------------------
+
+
+def cmd_rca_replay(args) -> int:
+    """Offline replay of a saved incident (or bare evidence bundle): re-run
+    the cause classifier and suspect ranking over the recorded evidence so
+    an attribution can be audited — or re-derived after a classifier fix —
+    without a running cluster."""
+    from tempo_tpu.graph.walks import rank_suspects
+    from tempo_tpu.rca.classify import classify
+
+    with open(args.bundle, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    # Accept either a full incident record (as served by /api/rca/{id})
+    # or just its "evidence" object.
+    evidence = doc.get("evidence", doc)
+    finding = classify(evidence)
+    walk_doc = evidence.get("walks") or {}
+    suspects = evidence.get("suspects") or []
+    if walk_doc.get("edgeVisits") and not suspects:
+        suspects = rank_suspects(walk_doc)
+    if args.json:
+        print(json.dumps({"finding": finding, "suspects": suspects}, indent=2, sort_keys=True))
+        return 0
+    print(f"cause:      {finding['cause']}" + ("  (suppressed)" if finding.get("suppressed") else ""))
+    for k in ("tier", "service", "stage", "suspect"):
+        if finding.get(k):
+            print(f"{k + ':':<11} {finding[k]}")
+    if finding.get("details"):
+        print(f"details:    {finding['details']}")
+    recorded = doc.get("finding")
+    if recorded and recorded.get("cause") != finding["cause"]:
+        print(f"note: recorded finding was {recorded.get('cause')!r}; "
+              f"replay classified {finding['cause']!r}")
+    if suspects:
+        _print_table(
+            [[s.get("edge", ""), s.get("edgeVisits", 0), s.get("serverVisits", 0)] for s in suspects],
+            ["suspect edge", "edge visits", "server visits"],
+        )
+    exemplars = evidence.get("exemplarTraceIds") or []
+    if exemplars:
+        print("exemplar traces: " + ", ".join(exemplars[:5]))
+    return 0
+
+
 # -- wiring ----------------------------------------------------------------
 
 
@@ -787,6 +832,17 @@ def build_parser() -> argparse.ArgumentParser:
     cv.add_argument("--mark-compacted", action="store_true",
                     help="mark the source block compacted after converting")
     cv.set_defaults(fn=cmd_convert)
+
+    rca = sub.add_parser(
+        "rca", help="auto-RCA incident tooling (offline)"
+    ).add_subparsers(dest="what", required=True)
+    rr = rca.add_parser(
+        "replay",
+        help="re-run cause classification over a saved incident/evidence JSON",
+    )
+    rr.add_argument("bundle", help="incident record (from /api/rca/{id}) or bare evidence JSON")
+    rr.add_argument("--json", action="store_true")
+    rr.set_defaults(fn=cmd_rca_replay)
 
     return p
 

@@ -31,6 +31,7 @@ from tempo_tpu.modules.frontend import FrontendConfig
 from tempo_tpu.modules.generator.storage import RemoteWriteConfig
 from tempo_tpu.modules.ingester import IngesterConfig
 from tempo_tpu.modules.overrides import Limits
+from tempo_tpu.rca import RCAConfig
 from tempo_tpu.standing import StandingConfig
 from tempo_tpu.usagestats import UsageStatsConfig
 from tempo_tpu.util import slo as slo_mod
@@ -204,6 +205,9 @@ def parse_config(text: str, env: dict | None = None) -> Config:
     # TEMPO_TPU_COMPILED=0 routes every query to the interpreter)
     app.compiled = _from_dict(
         CompiledConfig, doc.pop("compiled", None), "compiled")
+    # auto-RCA incident engine (triggered by SLO burns / standing
+    # deviations; check_config warns when its triggers are disabled)
+    app.rca = _from_dict(RCAConfig, doc.pop("rca", None), "rca")
     # burn-rate SLO engine; objectives is a LIST of dataclasses, handled
     # like distributor.forwarders
     slo_doc = doc.pop("slo", {}) or {}
@@ -492,4 +496,18 @@ def check_config(cfg: Config) -> list[str]:
                     f"slo objective {obj.name!r} target {obj.objective} is "
                     "outside (0, 1): burn rates are undefined"
                 )
+    # -- auto-RCA incident engine -----------------------------------------
+    if app.rca.enabled and not app.slo.enabled:
+        warnings.append(
+            "rca is enabled without slo: the fast-burn trigger never "
+            "fires, so incidents only open on standing-query deviations "
+            "(enable slo for the full closed loop)"
+        )
+    if app.rca.enabled and not app.standing.enabled:
+        warnings.append(
+            "rca is enabled without standing: the deviation trigger never "
+            "fires, so anomalies cannot open incidents BEFORE the SLO "
+            "burns (enable standing and register queries with a "
+            "deviation: section)"
+        )
     return warnings

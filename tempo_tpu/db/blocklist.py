@@ -119,6 +119,14 @@ class Blocklist:
         with self._lock:
             return dict(self._quarantined.get(tenant, {}))
 
+    def quarantined_report(self) -> dict[str, dict[str, str]]:
+        """All quarantined blocks across tenants ({tenant -> {block id ->
+        reason}}) — the RCA evidence-bundle accessor: an incident must be
+        able to ask "is ANY storage quarantined right now" without
+        enumerating tenants."""
+        with self._lock:
+            return {t: dict(bad) for t, bad in self._quarantined.items() if bad}
+
     def is_quarantined(self, tenant: str, block_id: str) -> bool:
         with self._lock:
             return block_id in self._quarantined.get(tenant, ())

@@ -132,3 +132,30 @@ def sample_walks(edges: dict, seed: int = 0, walks: int = 32, steps: int = 6,
         "edgeVisits": dict(sorted(edge_visits.items(), key=lambda kv: (-kv[1], kv[0]))),
         "seed": seed,
     }
+
+
+def rank_suspects(walk_doc: dict, exclude: tuple = (), top: int = 5) -> list[dict]:
+    """Rank suspect dependency edges out of a sample_walks document.
+
+    Walks seeded at a burning service follow the call direction, so the
+    edges they traverse most are the dependencies most causally coupled
+    to the burning node inside the temporal window — the RCA plane's
+    "upstream suspect" ranking. Deterministic: ties break by edge name,
+    and the input doc is itself seed-deterministic, so the same incident
+    replays to the same ranking (`cli rca replay`)."""
+    visits = walk_doc.get("visits", {})
+    suspects = []
+    for ek, n in walk_doc.get("edgeVisits", {}).items():
+        client, _, server = ek.partition(" -> ")
+        if server in exclude:
+            continue
+        suspects.append({
+            "edge": ek,
+            "client": client,
+            "server": server,
+            "edgeVisits": int(n),
+            "serverVisits": int(visits.get(server, 0)),
+        })
+    suspects.sort(key=lambda s: (-s["edgeVisits"], -s["serverVisits"],
+                                 s["edge"]))
+    return suspects[: max(1, top)]

@@ -93,6 +93,16 @@ rebuilds_total = metrics.counter(
     "tempo_tpu_standing_rebuilds_total",
     "Standing accumulator rebuilds from storage (restart or shed-heal)",
 )
+deviation_firing_gauge = metrics.gauge(
+    "tempo_tpu_standing_deviation_firing",
+    "1 while a standing query's seasonal-deviation detector is firing "
+    "for any series, by query id",
+)
+deviation_fires_total = metrics.counter(
+    "tempo_tpu_standing_deviation_fires_total",
+    "Per-series deviation transitions (not-deviating -> deviating), "
+    "by query id",
+)
 
 
 @dataclass
@@ -146,9 +156,43 @@ def _register_engine(engine) -> None:
     metrics.register_collector(collect)
 
 
+def normalize_deviation(deviation: dict | None, step_s: int,
+                        window_s: int) -> dict | None:
+    """Validate + normalize a registration's `deviation` section.
+    The detector compares the latest complete bin against a seasonal
+    baseline folded from the SAME accumulator (the mean of the bins one,
+    two, ... seasons back inside the window), so it needs the season to
+    sit on the step grid and the window to hold at least one full
+    baseline season besides the current one."""
+    if not deviation:
+        return None
+    season = int(deviation.get("season", 0))
+    if season <= 0 or season % step_s != 0:
+        raise ValueError(
+            "deviation.season must be a positive multiple of step "
+            f"({step_s}s)")
+    if window_s < 2 * season:
+        raise ValueError(
+            f"deviation needs window >= 2*season ({2 * season}s) so at "
+            "least one full baseline season is retained")
+    factor = float(deviation.get("factor", 2.0))
+    if factor <= 1.0:
+        raise ValueError("deviation.factor must be > 1.0")
+    direction = deviation.get("direction", "above")
+    if direction not in ("above", "below"):
+        raise ValueError("deviation.direction must be 'above' or 'below'")
+    return {
+        "season": season,
+        "factor": factor,
+        "min_count": int(deviation.get("min_count", 1)),
+        "direction": direction,
+    }
+
+
 class StandingQuery:
     def __init__(self, qid: str, tenant: str, query: str, step_s: int,
-                 window_s: int, alert: dict | None, max_series: int):
+                 window_s: int, alert: dict | None, max_series: int,
+                 deviation: dict | None = None):
         from tempo_tpu.metrics_engine import SeriesTable, compile_metrics_plan
 
         self.id = qid
@@ -157,6 +201,8 @@ class StandingQuery:
         self.step_s = int(step_s)
         self.window_s = int(window_s)
         self.alert = dict(alert) if alert else None
+        self.deviation = normalize_deviation(deviation, int(step_s),
+                                             int(window_s))
         self.max_series = int(max_series)
         # one-bin template: validates the query via the exact grammar /
         # planner query_range uses (client errors fail registration)
@@ -177,6 +223,8 @@ class StandingQuery:
         self.partial_row_groups = 0  # rebuilt-from-step-partials count
         self.dirty = False
         self.firing: dict = {}  # series key -> bool
+        self.deviating: dict = {}  # series key -> bool
+        self.deviation_fires = 0
         self.rebuilt_segs: set = set()  # WAL seg keys replayed by rebuild
 
     # -- helpers ---------------------------------------------------------
@@ -191,6 +239,7 @@ class StandingQuery:
                 "step": self.step_s,
                 "window": self.window_s,
                 "alert": dict(self.alert) if self.alert else None,
+                "deviation": dict(self.deviation) if self.deviation else None,
                 "maxSeries": self.max_series,
                 "createdUnix": int(self.created_unix),
             }
@@ -204,8 +253,12 @@ class StandingQuery:
                     "step": self.step_s,
                     "window": self.window_s,
                     "alert": dict(self.alert) if self.alert else None,
+                    "deviation": (dict(self.deviation)
+                                  if self.deviation else None),
                 },
                 "firing": {str(k): bool(v) for k, v in self.firing.items() if v},
+                "deviating": {str(k): bool(v)
+                              for k, v in self.deviating.items() if v},
                 "stats": {
                     "folds": self.folds,
                     "spansFolded": self.fold_spans,
@@ -217,6 +270,7 @@ class StandingQuery:
                     "series": len(self.series.slots),
                     "bins": len(self.counts),
                     "dirty": self.dirty,
+                    "deviationFires": self.deviation_fires,
                 },
             }
 
@@ -245,6 +299,11 @@ class StandingEngine:
         self.snapshot_path: str | None = None
         self._last_snapshot = 0.0
         self.cut_spans: dict[str, int] = {}  # tenant -> delta spans offered
+        # deviation transitions queue under q.lock and drain to
+        # subscribers outside any lock (the RCA trigger seam)
+        self._dev_subs: list = []
+        self._dev_events: list = []
+        self._dev_lock = threading.Lock()
 
     # -- wiring ----------------------------------------------------------
     def attach(self, db=None, ingesters: dict | None = None,
@@ -276,9 +335,18 @@ class StandingEngine:
                 cap = t_cap
         return cap
 
+    def subscribe_deviations(self, cb) -> None:
+        """Register cb(event) for per-series deviation transitions.
+        Events carry kind="standing_deviation", the query id/tenant, the
+        series key and the current/baseline counts. Fired outside every
+        engine lock; a raising subscriber is logged, never propagated
+        into the fold path."""
+        self._dev_subs.append(cb)
+
     def register(self, tenant: str, query: str, step_s: int,
                  window_s: int = 0, alert: dict | None = None,
-                 max_series: int = 64) -> StandingQuery:
+                 max_series: int = 64,
+                 deviation: dict | None = None) -> StandingQuery:
         if step_s <= 0:
             raise ValueError("step must be positive")
         window_s = int(window_s) or self.cfg.default_window_s
@@ -298,7 +366,8 @@ class StandingEngine:
                     f"tenant {tenant}: {held} standing queries registered "
                     f"(cap {cap}); delete one first", retry_after_s=60.0)
             q = StandingQuery(f"sq-{uuid.uuid4().hex[:12]}", tenant, query,
-                              step_s, window_s, alert, max_series)
+                              step_s, window_s, alert, max_series,
+                              deviation=deviation)
             # backfill: the store may already hold this window's spans —
             # a fresh accumulator would silently read as zero traffic.
             # dirty routes the first read through the exact rebuild
@@ -330,6 +399,7 @@ class StandingEngine:
             held = sum(1 for x in self._queries.values() if x.tenant == tenant)
         standing_queries_gauge.set(held, tenant=tenant)
         alert_firing_gauge.drop_labels(query_id=q.id)
+        deviation_firing_gauge.drop_labels(query_id=q.id)
         self.maybe_snapshot(force=True)
 
     def state(self, tenant: str, qid: str) -> dict:
@@ -339,16 +409,21 @@ class StandingEngine:
         q = self.get(tenant, qid)
         with q.lock:
             self._eval_alert(q, time.time())
+            self._eval_deviation(q, time.time())
+        self._flush_deviation_events()
         return q.state_doc()
 
     def _refresh_alerts(self) -> None:
         """Scrape-time alert refresh (see _register_engine)."""
         with self._lock:
-            qs = [q for q in self._queries.values() if q.alert]
+            qs = [q for q in self._queries.values()
+                  if q.alert or q.deviation]
         now = time.time()
         for q in qs:
             with q.lock:
                 self._eval_alert(q, now)
+                self._eval_deviation(q, now)
+        self._flush_deviation_events()
 
     def tenants(self) -> list[str]:
         with self._lock:
@@ -433,6 +508,7 @@ class StandingEngine:
         for q in qs:
             with q.lock:
                 q.fold_seconds += dt / max(1, len(qs))
+        self._flush_deviation_events()
         self.maybe_snapshot()
 
     def _fold_one(self, q: StandingQuery, batch, dictionary) -> None:
@@ -482,6 +558,7 @@ class StandingEngine:
                                 q.counts[key] = q.counts.get(key, 0) + c
                             self._prune(q, now)
                             self._eval_alert(q, now)
+                            self._eval_deviation(q, now)
                 except Exception:
                     log.exception("resident tail fold failed; using the "
                                   "host path")
@@ -495,6 +572,7 @@ class StandingEngine:
                 self._apply_counts(q, plan, live, start // step)
             self._prune(q, now)
             self._eval_alert(q, now)
+            self._eval_deviation(q, now)
 
     def _apply_counts(self, q: StandingQuery, plan, live: np.ndarray,
                       bin_offset: int) -> None:
@@ -551,6 +629,82 @@ class StandingEngine:
             q.firing[key] = fire
             firing_any = firing_any or fire
         alert_firing_gauge.set(1 if firing_any else 0, query_id=q.id)
+
+    def _eval_deviation(self, q: StandingQuery, now: float) -> None:
+        """Per-series seasonal-deviation check: the latest COMPLETE bin
+        against the mean of the bins one, two, ... seasons back — a
+        baseline that is a pure function of the SAME associative,
+        psum-mergeable accumulator the folds maintain, so it is
+        bit-identical at cut boundaries and across ingester sharding for
+        free (no second fold, no extra state). Requires q.lock held;
+        transitions queue for subscribers, drained outside the lock by
+        _flush_deviation_events()."""
+        if not q.deviation:
+            return
+        dev = q.deviation
+        step = q.step_s
+        bin_ = int(now) // step - 1
+        season_bins = dev["season"] // step
+        # seasonal lags whose bins the prune floor still retains
+        floor_bin = int(now - q.window_s - 2 * step) // step
+        lags = [bin_ - k * season_bins
+                for k in range(1, q.window_s // dev["season"] + 1)
+                if bin_ - k * season_bins >= floor_bin]
+        if not lags:
+            return
+        cur: dict[int, int] = {}
+        base: dict[int, int] = {}
+        lag_set = set(lags)
+        for (s, b, _k), c in q.counts.items():
+            if b == bin_:
+                cur[s] = cur.get(s, 0) + c
+            elif b in lag_set:
+                base[s] = base.get(s, 0) + c
+        factor, min_count = dev["factor"], dev["min_count"]
+        above = dev["direction"] == "above"
+        slot_keys = q._slot_keys()
+        deviating_any = False
+        for s, key in slot_keys.items():
+            c = cur.get(s, 0)
+            baseline = base.get(s, 0) / len(lags)
+            if above:
+                fire = c >= min_count and c > factor * baseline
+            else:
+                fire = baseline >= min_count and c * factor < baseline
+            was = q.deviating.get(key, False)
+            q.deviating[key] = fire
+            deviating_any = deviating_any or fire
+            if fire and not was:
+                q.deviation_fires += 1
+                deviation_fires_total.inc(query_id=q.id)
+                with self._dev_lock:
+                    self._dev_events.append({
+                        "kind": "standing_deviation",
+                        "queryId": q.id,
+                        "tenant": q.tenant,
+                        "query": q.query,
+                        "series": str(key),
+                        "bin": bin_,
+                        "at": now,
+                        "current": c,
+                        "baseline": baseline,
+                        "factor": factor,
+                        "direction": dev["direction"],
+                    })
+        deviation_firing_gauge.set(1 if deviating_any else 0, query_id=q.id)
+
+    def _flush_deviation_events(self) -> None:
+        """Deliver queued deviation transitions to subscribers. Never
+        raises (fold/cut path safety); must be called with NO engine or
+        query lock held."""
+        with self._dev_lock:
+            events, self._dev_events = self._dev_events, []
+        for event in events:
+            for cb in list(self._dev_subs):
+                try:
+                    cb(dict(event))
+                except Exception:
+                    log.exception("standing deviation subscriber failed")
 
     # -- read ------------------------------------------------------------
     def read(self, tenant: str, qid: str, start_s: int = 0, end_s: int = 0,
@@ -729,14 +883,17 @@ class StandingEngine:
                         q.counts = tmp_counts
                         q.series = tmp_series
                         q.firing = {}
+                        q.deviating = {}
                         q.dirty = not (poll_ok and blocks_ok and wal_ok
                                        and not flushed_unseen)
                         q.rebuilds += 1
                         q.rebuilt_segs = seg_keys
                         q.partial_row_groups += n_partial_rgs
                         self._eval_alert(q, now)
+                        self._eval_deviation(q, now)
                     break
             rebuilds_total.inc()
+        self._flush_deviation_events()
 
     def _rebuild_blocks(self, q: StandingQuery, metas: list, w_lo: int,
                         tmp_counts: dict, tmp_series) -> tuple[int, bool]:
@@ -1034,7 +1191,8 @@ class StandingEngine:
             try:
                 q = StandingQuery(d["id"], d["tenant"], d["query"], d["step"],
                                   d["window"], d.get("alert"),
-                                  d.get("maxSeries", 64))
+                                  d.get("maxSeries", 64),
+                                  deviation=d.get("deviation"))
                 for key in d.get("series", []):
                     q.series.slot_of(key)
                 q.counts = {(int(s), int(b), int(k)): int(c)

@@ -148,13 +148,23 @@ class InsightLog:
             slow_log.warning("query-insight %s", json.dumps(rec, sort_keys=True))
 
     # ------------------------------------------------------------------
-    def snapshot(self, tenant: str | None = None, limit: int = 50) -> list[dict]:
-        """Newest-first records, optionally one tenant's only."""
+    def snapshot(self, tenant: str | None = None, limit: int = 50,
+                 since_unix: float | None = None,
+                 reasons: tuple | None = None) -> list[dict]:
+        """Newest-first records, optionally one tenant's only, optionally
+        restricted to records captured at/after `since_unix` and/or to a
+        set of captureReason values — the RCA evidence-snapshot seam, so
+        an incident bundles only the affected window's interesting
+        records instead of the whole ring."""
         with self._lock:
             records = list(self._ring)
         records.reverse()
         if tenant is not None:
             records = [r for r in records if r.get("tenant") == tenant]
+        if since_unix is not None:
+            records = [r for r in records if r.get("ts", 0.0) >= since_unix]
+        if reasons is not None:
+            records = [r for r in records if r.get("captureReason") in reasons]
         return records[: max(1, limit)]
 
     def clear(self) -> None:

@@ -194,9 +194,12 @@ def _sli_vulture(obj: SLOObjective) -> tuple[float, float]:
     """good/total over ALL vulture checks: each executed check counts
     one event (tempo_vulture_check_total) and each failed check counts
     exactly one error class (tempo_vulture_error_total), so
-    good = checks - errors."""
+    good = checks - errors. The blocklist-poll handoff dip is a typed,
+    expected artifact (vulture.py classifies it `handoff_dip`) — it
+    must not burn the budget, so it is excluded from bad here."""
     total = _counter_sum("tempo_vulture_check_total")
-    bad = _counter_sum("tempo_vulture_error_total")
+    bad = _counter_sum("tempo_vulture_error_total",
+                       lambda lbl: lbl.get("type") != "handoff_dip")
     return total - min(bad, total), total
 
 
@@ -314,6 +317,10 @@ class SLOEngine:
         self._thread: threading.Thread | None = None
         self._last_status: dict = {}
         self._last_eval_wall = 0.0
+        # burn-transition subscribers (the RCA trigger seam): cb(event)
+        # fired when an objective's page condition flips False -> True
+        self._subs: list = []
+        self._was_paging: dict[str, bool] = {}
         # ring retention: the slow window plus slack for the window base
         self._keep_s = WINDOW_S[BUDGET_WINDOW] + 4 * max(
             self.cfg.eval_interval_s, 1.0)
@@ -324,6 +331,7 @@ class SLOEngine:
         drive this; `now` is injectable for deterministic window math).
         Returns the /status/slo document."""
         now = time.time() if now is None else now
+        fired: list[dict] = []
         doc: dict = {
             "enabled": True,
             "evaluatedAt": now,
@@ -401,8 +409,27 @@ class SLOEngine:
                     },
                     "burning": {"page": fast, "ticket": slow},
                 })
+                if fast and not self._was_paging.get(obj.name, False):
+                    fired.append({
+                        "kind": "slo_burn",
+                        "slo": obj.name,
+                        "sli": obj.sli,
+                        "at": now,
+                        "burns": dict(burns),
+                        "errorRate": windows[FAST_WINDOWS[0]]["errorRate"],
+                    })
+                self._was_paging[obj.name] = fast
             self._last_status = doc
             self._last_eval_wall = time.time()
+        # outside the lock: a subscriber may re-enter status()/burning()
+        # or run arbitrary evidence collection; it must never be able to
+        # deadlock or kill the evaluation loop
+        for event in fired:
+            for cb in list(self._subs):
+                try:
+                    cb(dict(event))
+                except Exception:
+                    log.exception("SLO burn subscriber failed")
         return doc
 
     def status(self, max_age_s: float | None = None) -> dict:
@@ -417,6 +444,13 @@ class SLOEngine:
             if fresh_enough:
                 return dict(self._last_status)
         return self.evaluate()
+
+    def subscribe(self, cb) -> None:
+        """Register cb(event) for page-burn transitions. The event dict
+        carries kind="slo_burn", the objective name/sli, the evaluation
+        timestamp and the per-window burn rates. Fired once per
+        False->True page transition, outside the engine lock."""
+        self._subs.append(cb)
 
     def burning(self, name: str, severity: str = "page") -> bool:
         for o in self._last_status.get("objectives", []):

@@ -37,7 +37,13 @@ spans sit invisible to `query_range` for up to blocklist_poll_s right
 after an ingester hands a block off — the metrics recent job scans
 live/WAL only (flushed blocks would double-count) while the block jobs
 see the blocklist as of the last poll. A metrics_mismatch that heals
-within one poll interval is that gap; one that persists is real.
+within one poll interval is that gap; one that persists is real. The
+gap is TYPED: an undercount-only mismatch on a probe still inside the
+handoff grace window (handoff_grace_s; auto-derived from the app's
+blocklist_poll_s in-process) records as `handoff_dip` instead of
+`metrics_mismatch`, so SLO burn accounting (util/slo._sli_vulture) and
+RCA incident attribution (tempo_tpu/rca) can suppress it as a known
+artifact — it never pollutes chaos ground truth.
 STANDING-query reads (tempo_tpu/standing, /api/metrics/standing) are
 immune by construction — the cut's delta is already in the standing
 accumulator before the block ever reaches the backend — so dashboards
@@ -73,6 +79,9 @@ ERROR_TYPES = (
     "metrics_mismatch",
     "freshness_breach",
     "request_failed",
+    # the blocklist-poll handoff gap, typed so consumers can suppress it
+    # (see module docstring); never counted as a correctness failure
+    "handoff_dip",
 )
 
 CHECKS = ("write", "byid", "search", "traceql", "metrics", "freshness")
@@ -129,6 +138,12 @@ class VultureConfig:
     freshness_slo_s: float = 10.0
     # query_range step for the metrics readback check
     metrics_step_s: int = 5
+    # handoff-dip typing window: an undercount-only metrics_mismatch on
+    # a probe younger than recent_min_age_s + this grace is classified
+    # `handoff_dip` (the known blocklist-poll transient, see module
+    # docstring) instead of metrics_mismatch. 0 = auto: the driven app's
+    # db.blocklist_poll_s when in-process, else disabled.
+    handoff_grace_s: float = 0.0
 
 
 class InProcessClient:
@@ -278,6 +293,15 @@ class Vulture:
             cfg = dataclasses.replace(cfg, retention_s=retention_s)
         self.client = client
         self.cfg = cfg
+        # handoff-dip grace: explicit config wins; in-process clients
+        # auto-derive from the driven app's blocklist poll cadence
+        self.handoff_grace_s = cfg.handoff_grace_s
+        if not self.handoff_grace_s and hasattr(client, "app"):
+            try:
+                self.handoff_grace_s = float(
+                    client.app.cfg.db.blocklist_poll_s)
+            except Exception:
+                self.handoff_grace_s = 0.0
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         # local mirrors of the process counters, per-instance: the
@@ -548,6 +572,22 @@ class Vulture:
                        if got.get(ts, 0) < n}
             extra = {ts: n for ts, n in got.items() if ts not in expected}
             if missing or extra:
+                # the known blocklist-poll handoff transient has a
+                # distinctive signature: PURE undercount (a freshly
+                # handed-off block invisible to the poll snapshot can
+                # only hide spans, never invent them) on a probe young
+                # enough that its block plausibly just left an ingester.
+                # Typed, not excused: it still counts a vulture error,
+                # but under a name SLO/RCA consumers suppress.
+                age_s = now_s - info.timestamp_s
+                if (missing and not extra and self.handoff_grace_s > 0
+                        and age_s <= (self.cfg.recent_min_age_s
+                                      + self.handoff_grace_s)):
+                    return self._fail(
+                        "handoff_dip", tier, "metrics", info,
+                        f"undercount within handoff grace "
+                        f"({self.handoff_grace_s:g}s): expected "
+                        f"{expected}, got {got}")
                 return self._fail(
                     "metrics_mismatch", tier, "metrics", info,
                     f"expected {expected}, got {got}")

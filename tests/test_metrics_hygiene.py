@@ -200,6 +200,19 @@ FAMILY_SERIES_BUDGETS = {
     # dropped at deregistration)
     "tempo_tpu_standing_queries": 64,
     "tempo_tpu_standing_alert_firing": 64,
+    # seasonal-deviation detector: per-query-id gauges/counters, same
+    # bound and same drop-at-deregistration discipline as alert_firing
+    "tempo_tpu_standing_deviation_firing": 64,
+    "tempo_tpu_standing_deviation_fires_total": 64,
+    # auto-RCA plane: trigger / cause / reason enums only — incident
+    # ids, tenants, and services must NEVER become labels here; the
+    # ranked detail lives on /api/rca/{incidentID}
+    "tempo_tpu_rca_incidents_total": 4,
+    "tempo_tpu_rca_attributed_total": 8,   # bounded by CAUSES
+    "tempo_tpu_rca_suppressed_total": 2,
+    "tempo_tpu_rca_triggers_dropped_total": 4,
+    "tempo_tpu_rca_open_incidents": 2,
+    "tempo_tpu_rca_time_to_attribution_seconds": 2,
     # compiled-query tier: label-less cache totals — shapes/programs
     # must NEVER become labels here; per-shape data belongs on
     # /api/query-insights

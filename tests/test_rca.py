@@ -1,0 +1,548 @@
+"""Auto-RCA plane (ISSUE 20): burn-rate / deviation triggers -> evidence
+bundle -> typed root cause, plus the standing-accumulator seasonal
+deviation detector feeding it.
+
+The load-bearing claims, each with a test:
+
+- chaos attribution: with a seeded TEMPO_TPU_FAULTS campaign armed, the
+  vulture SLI burns, the SLO page transition opens exactly one incident,
+  and its finding names `backend_fault` at the right storage tier;
+- zero false positives: the identical fault-free sequence opens nothing;
+- the typed handoff dip (the PR 11 blocklist-poll transient) neither
+  burns the vulture SLI nor survives classification as a real cause;
+- standing deviation detection fires off the SAME psum-mergeable
+  accumulator the folds maintain, so its verdict is bit-identical at
+  1/2/4-way ingester sharding — and it fires on a ramped anomaly while
+  the SLO engine is still quiet (anomaly-before-burn);
+- /api/rca read surface + config cross-checks.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from tempo_tpu.app import App, AppConfig
+from tempo_tpu.db import DBConfig
+from tempo_tpu.model import synth
+from tempo_tpu.rca import RCAConfig, UnknownIncident, classify
+from tempo_tpu.rca.engine import RCAEngine
+from tempo_tpu.standing import StandingConfig
+from tempo_tpu.util import slo
+from tempo_tpu.vulture import InProcessClient, TraceInfo, Vulture, VultureConfig
+
+RATE_Q = "{} | rate() by (resource.service.name)"
+
+
+def _mk_app(tmp, **kw):
+    return App(AppConfig(
+        db=DBConfig(backend="local", backend_path=str(tmp / "blocks"),
+                    wal_path=str(tmp / "wal")),
+        generator_enabled=False, **kw,
+    ))
+
+
+def _slo_cfg():
+    """Vulture-SLI objective evaluated manually (no background loop)."""
+    return slo.SLOConfig(
+        enabled=True, eval_interval_s=3600,
+        objectives=[slo.SLOObjective("vulture-read", "vulture", 0.999)])
+
+
+def _cut_all(app):
+    for ing in app.ingesters.values():
+        for inst in list(ing.instances.values()):
+            inst.cut_complete_traces(immediate=True)
+
+
+# ---------------------------------------------------------------------------
+# classification (pure, over plain evidence bundles)
+# ---------------------------------------------------------------------------
+
+class TestClassify:
+    def test_dip_only_is_suppressed(self):
+        f = classify({"vultureErrors": [
+            {"type": "handoff_dip", "tier": "fresh", "count": 3}]})
+        assert f["cause"] == "handoff_dip" and f["suppressed"] is True
+
+    def test_backend_fault_outranks_dip_and_names_tier(self):
+        f = classify({
+            "vultureErrors": [
+                {"type": "handoff_dip", "tier": "fresh", "count": 1},
+                {"type": "request_failed", "tier": "aged", "count": 5}],
+            "breakers": {"query-backend": {"state": 2, "stateName": "open"}},
+        })
+        assert f["cause"] == "backend_fault" and not f["suppressed"]
+        assert f["tier"] == "aged"
+        assert "query-backend" in f["details"]
+
+    def test_quarantine_alone_is_backend_fault(self):
+        f = classify({"quarantine": {"t": {"b1": "corrupt"}}})
+        assert f["cause"] == "backend_fault"
+        assert "quarantined" in f["details"]
+
+    def test_overload_shed(self):
+        f = classify({"governor": {"level": 1, "levelName": "pressure",
+                                   "shedDelta": 4.0}})
+        assert f["cause"] == "overload_shed"
+        assert "pressure" in f["details"]
+
+    def test_upstream_service_needs_dominant_edge(self):
+        suspects = [
+            {"edge": "api -> db", "client": "api", "server": "db",
+             "edgeVisits": 10, "serverVisits": 10},
+            {"edge": "api -> cache", "client": "api", "server": "cache",
+             "edgeVisits": 2, "serverVisits": 2},
+        ]
+        f = classify({"suspects": suspects})
+        assert f["cause"] == "upstream_service"
+        assert f["suspect"]["edge"] == "api -> db"
+        # flat distribution indicts nobody
+        flat = [dict(s, edgeVisits=5) for s in suspects]
+        assert classify({"suspects": flat})["cause"] == "unknown"
+
+    def test_slow_stage_from_insights_waterfall(self):
+        f = classify({"stageSeconds": {"fetch": 9.0, "decode": 0.4}})
+        assert f["cause"] == "slow_stage" and f["stage"] == "fetch"
+
+    def test_unknown_on_empty_evidence(self):
+        f = classify({})
+        assert f["cause"] == "unknown" and not f["suppressed"]
+
+
+# ---------------------------------------------------------------------------
+# the typed handoff dip: vulture classification + SLI exclusion
+# ---------------------------------------------------------------------------
+
+class TestHandoffDip:
+    @pytest.fixture
+    def app(self, tmp_path):
+        a = _mk_app(tmp_path)
+        yield a
+        a.shutdown()
+
+    def _mutilated_probe(self, app, ts):
+        """Store a probe missing one span: pure undercount on readback."""
+        info = TraceInfo(ts, "single-tenant")
+        full = info.construct_trace()
+        resource, spans = full.batches[0]
+        mut = type(full)(trace_id=full.trace_id,
+                         batches=[(resource, spans[:-1])])
+        for r, s in full.batches[1:]:
+            mut.batches.append((r, s))
+        app.push_traces(mut if isinstance(mut, list) else [mut])
+        app.sweep_all(immediate=True)
+        app.db.poll_now()
+        return info
+
+    def test_young_undercount_types_as_handoff_dip(self, app):
+        now = int(time.time()) - int(time.time()) % 10
+        info = self._mutilated_probe(app, now)
+        v = Vulture(InProcessClient(app),
+                    cfg=VultureConfig(write_backoff_s=10, handoff_grace_s=30))
+        v.first_write_s = now
+        assert not v.check_metrics(now, tier="fresh", info=info)
+        assert v.error_counts[("handoff_dip", "fresh")] == 1
+        assert ("metrics_mismatch", "fresh") not in v.error_counts
+
+    def test_old_undercount_stays_metrics_mismatch(self, app):
+        """Beyond recent_min_age_s + grace the block cannot plausibly
+        have just left an ingester: a real mismatch, not the dip."""
+        now = int(time.time()) - int(time.time()) % 10
+        ts = now - 7200
+        info = self._mutilated_probe(app, ts)
+        v = Vulture(InProcessClient(app),
+                    cfg=VultureConfig(write_backoff_s=10, handoff_grace_s=30))
+        v.first_write_s = ts
+        assert not v.check_metrics(now, tier="aged", info=info)
+        assert v.error_counts[("metrics_mismatch", "aged")] == 1
+        assert ("handoff_dip", "aged") not in v.error_counts
+
+    def test_grace_auto_derived_from_blocklist_poll(self, app):
+        v = Vulture(InProcessClient(app), write_backoff_s=10)
+        assert v.handoff_grace_s == pytest.approx(
+            float(app.cfg.db.blocklist_poll_s))
+
+    def test_dip_excluded_from_vulture_sli(self):
+        from tempo_tpu.util import metrics
+
+        errs = metrics.REGISTRY.get("tempo_vulture_error_total")
+        good0, total0 = slo._sli_vulture(
+            slo.SLOObjective("vulture-read", "vulture"))
+        errs.inc(type="handoff_dip", tier="fresh")
+        good1, total1 = slo._sli_vulture(
+            slo.SLOObjective("vulture-read", "vulture"))
+        # a dip error burns nothing: good - total unchanged
+        assert (total1 - good1) == pytest.approx(total0 - good0)
+        errs.inc(type="request_failed", tier="fresh")
+        good2, total2 = slo._sli_vulture(
+            slo.SLOObjective("vulture-read", "vulture"))
+        assert (total2 - good2) == pytest.approx(total0 - good0 + 1)
+
+
+# ---------------------------------------------------------------------------
+# trigger plumbing: SLO page transitions + RCA intake discipline
+# ---------------------------------------------------------------------------
+
+class TestTriggers:
+    @pytest.fixture
+    def fake_sli(self):
+        cell = {"good": 0.0, "total": 0.0}
+        slo.register_sli_source(
+            "rca-fake-sli", lambda obj: (cell["good"], cell["total"]))
+        yield cell
+        del slo.SLI_SOURCES["rca-fake-sli"]
+
+    def _engine(self):
+        return slo.SLOEngine(slo.SLOConfig(objectives=[
+            slo.SLOObjective("fake", "rca-fake-sli", 0.999)]))
+
+    def test_subscriber_fires_on_page_transition_only(self, fake_sli):
+        eng, events = self._engine(), []
+        eng.subscribe(events.append)
+        eng.evaluate(now=0.0)
+        fake_sli.update(good=0.0, total=100.0)
+        eng.evaluate(now=60.0)
+        assert [e["kind"] for e in events] == ["slo_burn"]
+        assert events[0]["slo"] == "fake" and events[0]["at"] == 60.0
+        # still burning: no re-fire while the page condition holds
+        fake_sli.update(good=0.0, total=200.0)
+        eng.evaluate(now=120.0)
+        assert len(events) == 1
+
+    def test_subscriber_exception_never_breaks_evaluate(self, fake_sli):
+        eng = self._engine()
+        eng.subscribe(lambda e: (_ for _ in ()).throw(RuntimeError("boom")))
+        eng.evaluate(now=0.0)
+        fake_sli.update(good=0.0, total=100.0)
+        doc = eng.evaluate(now=60.0)  # must not raise
+        assert doc["objectives"][0]["burning"]["page"] is True
+
+    def test_cooldown_coalesces_repeat_triggers(self, tmp_path):
+        app = _mk_app(tmp_path, rca=RCAConfig(enabled=True, cooldown_s=300))
+        try:
+            app.rca.on_slo_burn({"kind": "slo_burn", "slo": "x", "at": 1000.0})
+            app.rca.on_slo_burn({"kind": "slo_burn", "slo": "x", "at": 1010.0})
+            assert app.rca._queue.qsize() == 1
+            # a different SLO is a different incident key
+            app.rca.on_slo_burn({"kind": "slo_burn", "slo": "y", "at": 1010.0})
+            assert app.rca._queue.qsize() == 2
+            # past the cooldown the same key fires again
+            app.rca.on_slo_burn({"kind": "slo_burn", "slo": "x", "at": 1400.0})
+            assert app.rca._queue.qsize() == 3
+        finally:
+            app.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# chaos campaign: seeded faults -> attributed incident; clean -> nothing
+# ---------------------------------------------------------------------------
+
+class TestChaosAttribution:
+    def _drive(self, app, fail_expected: bool):
+        """One vulture campaign + two manual SLO evaluations around it."""
+        t0 = time.time()
+        app.slo_engine.evaluate(now=t0)
+        now = int(time.time())
+        v = Vulture(InProcessClient(app), write_backoff_s=10)
+        info = v.write_once(now - 7200)  # aged-tier probe
+        app.sweep_all(immediate=True)
+        try:
+            app.db.poll_now()
+        except Exception:
+            pass  # a faulted poll is part of the campaign
+        ok = v.check_metrics(now, tier="aged", info=info)
+        assert ok is not fail_expected
+        app.slo_engine.evaluate(now=t0 + 60)
+
+    def test_seeded_fault_campaign_attributes_backend_fault(
+            self, tmp_path, monkeypatch):
+        """TEMPO_TPU_FAULTS campaign: the stored probe vanishes from the
+        read path, the vulture SLI fast-burns, and the resulting incident
+        names backend_fault at the tier the campaign actually hit."""
+        monkeypatch.setenv("TEMPO_TPU_FAULTS", "notfound=1.0,seed=7")
+        app = _mk_app(tmp_path, slo=_slo_cfg(), rca=RCAConfig(enabled=True))
+        try:
+            self._drive(app, fail_expected=True)
+            event = app.rca._queue.get_nowait()
+            assert event["kind"] == "slo_burn"
+            assert event["slo"] == "vulture-read"
+            inc = app.rca.process_trigger(event)
+            f = inc["finding"]
+            assert f["cause"] == "backend_fault"
+            assert f["suppressed"] is False
+            assert f["tier"] == "aged"
+            assert "vulture backend-path error" in f["details"]
+            # the read surface sees exactly this incident
+            lst = app.rca_list()
+            assert [i["id"] for i in lst] == [inc["id"]]
+            assert lst[0]["trigger"] == "slo_burn"
+            got = app.rca_get(inc["id"])
+            assert got["finding"]["cause"] == "backend_fault"
+            assert got["evidence"]["vultureErrors"]
+        finally:
+            app.shutdown()
+
+    def test_fault_free_arm_opens_nothing(self, tmp_path):
+        """Identical sequence, no faults: zero incidents, zero triggers —
+        the zero-false-positive arm of the campaign."""
+        app = _mk_app(tmp_path, slo=_slo_cfg(), rca=RCAConfig(enabled=True))
+        try:
+            self._drive(app, fail_expected=False)
+            assert app.rca._queue.qsize() == 0
+            assert app.rca_list() == []
+            assert app.rca.status() == {
+                "incidents": 0, "suppressed": 0, "queue": 0}
+        finally:
+            app.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# standing deviation: seasonal baseline off the fold accumulator
+# ---------------------------------------------------------------------------
+
+def _aligned(step=60):
+    return (int(time.time()) // step) * step
+
+
+def _deviation_run(tmp_path, n_ingesters, rca=False):
+    """Seasonal baseline + a 10x spike in the latest complete bin;
+    returns (app, doc, events, now_eval) with the deviation evaluated at
+    a FIXED timestamp so runs are comparable across shard counts."""
+    kw = {"rca": RCAConfig(enabled=True)} if rca else {}
+    app = _mk_app(tmp_path, n_ingesters=n_ingesters, **kw)
+    anchor = _aligned() - 120          # start of the "latest complete bin"
+    now_eval = anchor + 60             # -> _eval_deviation picks bin anchor//60
+    doc = app.standing_register({
+        "q": RATE_Q, "step": 60, "window": 3600,
+        "deviation": {"season": 600, "factor": 3.0, "min_count": 2},
+    })
+    # baseline: 1 light trace at each of the first two seasonal lags
+    for k in (1, 2):
+        app.push_traces(synth.make_traces(
+            1, seed=50 + k, spans_per_trace=2,
+            base_time_ns=(anchor - k * 600) * 10**9))
+    # the anomaly: a 10x burst in the current bin
+    app.push_traces(synth.make_traces(
+        10, seed=60, spans_per_trace=4, base_time_ns=anchor * 10**9))
+    _cut_all(app)
+    events = []
+    app.standing.subscribe_deviations(events.append)
+    eng = app.standing
+    q = eng._queries[doc["id"]]
+    with q.lock:
+        eng._eval_deviation(q, now_eval)
+    eng._flush_deviation_events()
+    return app, doc, events, now_eval
+
+
+class TestStandingDeviation:
+    def test_registration_validation(self, tmp_path):
+        app = _mk_app(tmp_path)
+        try:
+            for bad in (
+                {"season": 90},                  # not a step multiple
+                {"season": 600, "factor": 0.5},  # factor must exceed 1
+                {"season": 3000},                # window < 2*season
+                {"season": 600, "direction": "sideways"},
+            ):
+                with pytest.raises(ValueError):
+                    app.standing_register({"q": RATE_Q, "step": 60,
+                                           "window": 3600, "deviation": bad})
+            doc = app.standing_register({
+                "q": RATE_Q, "step": 60, "window": 3600,
+                "deviation": {"season": 600}})
+            assert doc["deviation"] == {"season": 600, "factor": 2.0,
+                                        "min_count": 1, "direction": "above"}
+        finally:
+            app.shutdown()
+
+    def test_spike_fires_before_any_slo_burn(self, tmp_path):
+        """The ramped-anomaly fixture: deviation fires off the
+        accumulator while no SLO is burning — anomaly-before-burn."""
+        app, doc, events, now_eval = _deviation_run(tmp_path, n_ingesters=1,
+                                                    rca=True)
+        try:
+            assert events, "spike did not fire the deviation detector"
+            ev = events[0]
+            assert ev["kind"] == "standing_deviation"
+            assert ev["queryId"] == doc["id"]
+            assert ev["direction"] == "above"
+            assert ev["current"] > 3.0 * ev["baseline"]
+            assert ev["series"]  # the bare group-by value: a service name
+            # nothing is burning: this trigger precedes any SLO page
+            assert app.slo_engine is None
+            # the subscription opened an incident from the deviation alone
+            trig = app.rca._queue.get_nowait()
+            inc = app.rca.process_trigger(trig, now=now_eval)
+            assert inc["trigger"]["kind"] == "standing_deviation"
+            assert inc["trigger"]["service"]  # extracted from the series key
+            assert inc["tenant"] == "single-tenant"
+            assert app.rca_list()[0]["trigger"] == "standing_deviation"
+            # the state surface re-evaluates at wall-clock now (the spike
+            # bin is no longer the latest complete bin, so the flag may
+            # clear) — the fire COUNT is the durable record
+            st = app.standing_state(doc["id"])
+            assert st["stats"]["deviationFires"] >= 1
+        finally:
+            app.shutdown()
+
+    @pytest.mark.parametrize("n_ingesters", [1, 2, 4])
+    def test_verdict_bit_identical_across_sharding(self, tmp_path,
+                                                   n_ingesters):
+        """The baseline is a pure function of the psum-merged accumulator,
+        so the full deviation verdict — per-series flags, counts, fired
+        events — is identical at every shard count."""
+        app, doc, events, _ = _deviation_run(
+            tmp_path / str(n_ingesters), n_ingesters)
+        try:
+            q = app.standing._queries[doc["id"]]
+            with q.lock:
+                verdict = {
+                    "deviating": {str(k): v for k, v in q.deviating.items()},
+                    "fires": q.deviation_fires,
+                    "events": sorted(
+                        (e["series"], e["bin"], e["current"], e["baseline"])
+                        for e in events),
+                }
+            if not hasattr(TestStandingDeviation, "_verdicts"):
+                TestStandingDeviation._verdicts = {}
+            TestStandingDeviation._verdicts[n_ingesters] = verdict
+            seen = TestStandingDeviation._verdicts
+            assert verdict["events"], "detector must fire at every shard count"
+            first = seen[min(seen)]
+            assert verdict == first, (
+                f"deviation verdict diverged at {n_ingesters} shards")
+        finally:
+            app.shutdown()
+
+    def test_quiet_series_never_fires(self, tmp_path):
+        """Steady traffic at the seasonal level: no transitions."""
+        app = _mk_app(tmp_path)
+        try:
+            anchor = _aligned() - 120
+            doc = app.standing_register({
+                "q": RATE_Q, "step": 60, "window": 3600,
+                "deviation": {"season": 600, "factor": 3.0, "min_count": 2}})
+            for k in (0, 1, 2):  # same load in current bin and both lags
+                app.push_traces(synth.make_traces(
+                    2, seed=70, spans_per_trace=2,
+                    base_time_ns=(anchor - k * 600) * 10**9))
+            _cut_all(app)
+            events = []
+            app.standing.subscribe_deviations(events.append)
+            q = app.standing._queries[doc["id"]]
+            with q.lock:
+                app.standing._eval_deviation(q, anchor + 60)
+            app.standing._flush_deviation_events()
+            assert events == []
+            assert not any(q.deviating.values())
+        finally:
+            app.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# read surface + config
+# ---------------------------------------------------------------------------
+
+class TestAPI:
+    def _get(self, url):
+        with urllib.request.urlopen(url) as r:
+            return json.loads(r.read())
+
+    def test_disabled_surface(self, tmp_path):
+        from tempo_tpu.api.server import TempoServer
+
+        app = _mk_app(tmp_path)
+        srv = TempoServer(app).start()
+        try:
+            assert self._get(srv.url + "/api/rca") == {
+                "enabled": False, "incidents": []}
+            assert self._get(srv.url + "/status/rca") == {"enabled": False}
+        finally:
+            srv.stop()
+            app.shutdown()
+
+    def test_incident_surface(self, tmp_path):
+        from tempo_tpu.api.server import TempoServer
+
+        app = _mk_app(tmp_path, rca=RCAConfig(enabled=True))
+        srv = TempoServer(app).start()
+        try:
+            inc = app.rca.process_trigger(
+                {"kind": "slo_burn", "slo": "x", "at": time.time()})
+            doc = self._get(srv.url + "/api/rca")
+            assert doc["enabled"] is True
+            assert [i["id"] for i in doc["incidents"]] == [inc["id"]]
+            got = self._get(srv.url + "/api/rca/" + inc["id"])
+            assert got["id"] == inc["id"] and got["finding"]
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                self._get(srv.url + "/api/rca/inc-nope")
+            assert exc.value.code == 404
+            st = self._get(srv.url + "/status/rca")
+            assert st["enabled"] is True and st["incidents"] == 1
+        finally:
+            srv.stop()
+            app.shutdown()
+
+    def test_tenant_isolation(self, tmp_path):
+        app = _mk_app(tmp_path, multitenancy_enabled=True,
+                      rca=RCAConfig(enabled=True))
+        try:
+            inc = app.rca.process_trigger(
+                {"kind": "standing_deviation", "tenant": "team-a",
+                 "at": time.time()})
+            assert [i["id"] for i in app.rca_list(org_id="team-a")] \
+                == [inc["id"]]
+            assert app.rca_list(org_id="team-b") == []
+            with pytest.raises(UnknownIncident):
+                app.rca_get(inc["id"], org_id="team-b")
+        finally:
+            app.shutdown()
+
+
+class TestConfig:
+    def test_rca_section_parses(self):
+        from tempo_tpu.config import parse_config
+
+        cfg = parse_config(
+            "rca:\n  enabled: true\n  window_s: 120\n  walks: 8\n")
+        assert cfg.app.rca.enabled and cfg.app.rca.window_s == 120
+        assert cfg.app.rca.walks == 8
+
+    def test_warn_rca_without_triggers(self):
+        from tempo_tpu.config import check_config, parse_config
+
+        warnings = check_config(parse_config(
+            "rca:\n  enabled: true\nstanding:\n  enabled: false\n"))
+        text = "\n".join(warnings)
+        assert "rca is enabled without slo" in text
+        assert "rca is enabled without standing" in text
+        quiet = check_config(parse_config(
+            "rca:\n  enabled: true\nslo:\n  enabled: true\n"))
+        assert not any("rca is enabled" in w for w in quiet)
+
+
+class TestMetricsSurface:
+    def test_rca_families_registered_and_counted(self, tmp_path):
+        from tempo_tpu.util import metrics
+
+        for fam in ("tempo_tpu_rca_incidents_total",
+                    "tempo_tpu_rca_attributed_total",
+                    "tempo_tpu_rca_suppressed_total",
+                    "tempo_tpu_rca_open_incidents",
+                    "tempo_tpu_rca_triggers_dropped_total",
+                    "tempo_tpu_rca_time_to_attribution_seconds",
+                    "tempo_tpu_standing_deviation_firing",
+                    "tempo_tpu_standing_deviation_fires_total"):
+            assert metrics.REGISTRY.get(fam) is not None, fam
+        app = _mk_app(tmp_path, rca=RCAConfig(enabled=True))
+        try:
+            inc_total = metrics.REGISTRY.get("tempo_tpu_rca_incidents_total")
+            base = inc_total.total(trigger="slo_burn")
+            app.rca.process_trigger(
+                {"kind": "slo_burn", "slo": "x", "at": time.time()})
+            assert inc_total.total(trigger="slo_burn") == base + 1
+        finally:
+            app.shutdown()

@@ -186,12 +186,16 @@ class TestVultureInProcess:
 
     def test_detects_metrics_mismatch(self, app):
         """query_range readback: a probe whose stored spans differ from
-        the expected per-bin series flags metrics_mismatch."""
+        the expected per-bin series flags metrics_mismatch. The probe is
+        aged past recent_min_age_s + the handoff grace — a YOUNG
+        undercount is typed handoff_dip instead (suppressed transient;
+        see test_rca.py TestHandoffDip for both sides of the split)."""
         import time as _time
 
         v = Vulture(InProcessClient(app), write_backoff_s=10)
         now = int(_time.time()) - int(_time.time()) % 10
-        info = TraceInfo(now, v.tenant)
+        probe_ts = now - 7200
+        info = TraceInfo(probe_ts, v.tenant)
         full = info.construct_trace()
         resource, spans = full.batches[0]
         mutilated = type(full)(trace_id=full.trace_id, batches=[(resource, spans[:-1])])
@@ -200,7 +204,7 @@ class TestVultureInProcess:
         app.push_traces([mutilated])
         app.sweep_all(immediate=True)
         app.db.poll_now()
-        v.first_write_s = now
+        v.first_write_s = probe_ts
         base = vulture_errors.total(type="metrics_mismatch")
         assert not v.check_metrics(now, tier="fresh", info=info)
         assert vulture_errors.total(type="metrics_mismatch") == base + 1

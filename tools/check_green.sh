@@ -68,6 +68,25 @@ if [ "$rc" -eq 0 ]; then
   fi
 fi
 
+# Auto-RCA fault campaign (ISSUE 20): the chaos suite as the RCA
+# plane's ground-truth generator. Two sequential single-binary
+# clusters, each dogfooding vulture -> SLO burn -> incident engine: a
+# TEMPO_TPU_FAULTS-seeded arm must open >=1 incident with EVERY
+# unsuppressed cause == backend_fault (the injected truth), and a
+# fault-free soak must open ZERO (the typed handoff dip never pages).
+rca_rc=0
+if [ "$rc" -eq 0 ]; then
+  timeout -k 10 420 python tools/loadtest.py --rca \
+    >/tmp/_t1_rca.json 2>/tmp/_t1_rca.log
+  rca_rc=$?
+  if [ "$rca_rc" -ne 0 ]; then
+    echo "check_green: auto-RCA campaign RED (exit $rca_rc)" >&2
+    tail -5 /tmp/_t1_rca.log >&2
+  else
+    echo "check_green: auto-RCA campaign green" >&2
+  fi
+fi
+
 if [ "$rc" -ne 0 ]; then
   echo "check_green: RED (pytest exit $rc)" >&2
 elif [ "$hot_rc" -ne 0 ]; then
@@ -76,6 +95,9 @@ elif [ "$hot_rc" -ne 0 ]; then
 elif [ "$rcache_rc" -ne 0 ]; then
   echo "check_green: RED (result-cache smoke exit $rcache_rc)" >&2
   rc=$rcache_rc
+elif [ "$rca_rc" -ne 0 ]; then
+  echo "check_green: RED (auto-RCA campaign exit $rca_rc)" >&2
+  rc=$rca_rc
 else
   echo "check_green: green" >&2
 fi

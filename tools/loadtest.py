@@ -1220,6 +1220,88 @@ def _scrape_resultcache(urls: list) -> dict:
     return out
 
 
+# ---------------------------------------------------------------------------
+# auto-RCA fault campaign (ISSUE 20): seeded backend fault -> exactly
+# one attributed machine-written incident; fault-free soak -> zero
+# ---------------------------------------------------------------------------
+
+RCA_EXTRA = """vulture:
+  enabled: true
+  write_backoff_s: 2
+  read_backoff_s: 2
+slo:
+  enabled: true
+  eval_interval_s: 1.0
+rca:
+  enabled: true
+"""
+
+
+def rca_campaign(fault_spec: str = "notfound=1.0,seed=7",
+                 soak_s: float = 25.0, deadline_s: float = 90.0) -> dict:
+    """Two sequential single-binary clusters, each dogfooding the whole
+    trigger loop (in-process vulture -> vulture SLI -> SLO fast burn ->
+    RCA engine), the chaos suite as ground-truth generator:
+
+    - faulted arm: TEMPO_TPU_FAULTS armed, so stored probes vanish from
+      the read path once they hand off. Gate: at least one incident
+      opens, and EVERY unsuppressed incident is attributed
+      `backend_fault` (the injected truth) — any other cause is a
+      false attribution.
+    - clean arm: identical soak, no faults. Gate: zero incidents — the
+      typed handoff dip must not page, burn, or open anything.
+    """
+    out: dict = {}
+    for arm, env in (("faulted", {"TEMPO_TPU_FAULTS": fault_spec}),
+                     ("clean", None)):
+        tmp = tempfile.mkdtemp(prefix=f"tempo-rca-{arm}-")
+        proc = Proc(tmp, "all", f"rca-{arm}", kv_url="local",
+                    extra=RCA_EXTRA, env_extra=env)
+        try:
+            proc.wait_ready()
+            t0 = time.time()
+            incidents: list = []
+            budget = deadline_s if arm == "faulted" else soak_s
+            while time.time() - t0 < budget:
+                time.sleep(2.0)
+                try:
+                    doc = _get_json(proc.url + "/api/rca")
+                except Exception:
+                    continue
+                incidents = doc.get("incidents", [])
+                if arm == "faulted" and incidents:
+                    # let the in-flight window settle, then re-read so
+                    # the gate sees every incident the burn opened
+                    time.sleep(3.0)
+                    incidents = _get_json(
+                        proc.url + "/api/rca").get("incidents", [])
+                    break
+            unsuppressed = [i for i in incidents if not i.get("suppressed")]
+            misattributed = [i for i in unsuppressed
+                             if i.get("cause") != "backend_fault"]
+            arm_doc = {
+                "incidents": len(incidents),
+                "unsuppressed": len(unsuppressed),
+                "causes": sorted({i.get("cause") for i in incidents}),
+                "elapsed_s": round(time.time() - t0, 1),
+            }
+            if arm == "faulted":
+                arm_doc["passed"] = bool(
+                    unsuppressed and not misattributed)
+                if incidents:
+                    top = incidents[0]
+                    arm_doc["first"] = {k: top.get(k) for k in
+                                        ("trigger", "cause", "tier")}
+            else:
+                arm_doc["passed"] = not incidents
+            out[arm] = arm_doc
+            print(f"[loadtest] rca {arm} arm: {arm_doc}", file=sys.stderr)
+        finally:
+            proc.terminate()
+    out["passed"] = out["faulted"]["passed"] and out["clean"]["passed"]
+    return out
+
+
 def repeat_probe(query_url: str, scrape_urls: list, iters: int = 5) -> dict:
     """Repeated-query arm against the result cache: freeze one search
     and one query_range at the synth epoch (identical block set every
@@ -1762,6 +1844,15 @@ def main() -> int:
                     help="spans/s/chip floor for the --ingest-heavy burst "
                          "(default sized for shared-core CI on the CPU "
                          "backend; raise it on real chips)")
+    ap.add_argument("--rca", action="store_true",
+                    help="run the auto-RCA fault campaign INSTEAD of the "
+                         "mixed load: two sequential single-binary "
+                         "clusters dogfooding vulture -> SLO burn -> "
+                         "incident, gated on a seeded TEMPO_TPU_FAULTS "
+                         "backend fault yielding >=1 attributed incident "
+                         "with cause backend_fault (and no other "
+                         "unsuppressed cause), and a fault-free soak "
+                         "yielding zero incidents")
     ap.add_argument("--tenants", type=int, default=1,
                     help=">1 enables multi-tenant mode: the cluster boots "
                          "with multitenancy, every op carries one of N org "
@@ -1774,6 +1865,14 @@ def main() -> int:
                  "so the compiled-shapes gates would never fire")
     multitenant = args.tenants > 1
     tenant_ids = [f"lt-tenant-{i}" for i in range(args.tenants)] if multitenant else None
+
+    if args.rca:
+        # the campaign boots its own faulted/clean single-binary clusters;
+        # a shared mixed-load cluster would pollute the clean-soak gate
+        summary = {"rca": rca_campaign()}
+        summary["passed"] = summary["rca"]["passed"]
+        print(json.dumps(summary))
+        return 0 if summary["passed"] else 1
 
     procs: list[Proc] = []
     tmpdir = None
